@@ -1,0 +1,23 @@
+"""distributed_embeddings_tpu — TPU-native distributed embedding framework.
+
+A JAX/XLA/Pallas re-design of the capability surface of NVIDIA's
+``distributed-embeddings`` (reference: ``distributed_embeddings/__init__.py:17-18``,
+which exports ``embedding_lookup`` and ``__version__``): large-embedding
+recommender training with hybrid model/data parallelism over a TPU mesh.
+"""
+
+from .version import __version__
+from .ops.embedding_lookup import (
+    Ragged,
+    SparseIds,
+    embedding_lookup,
+    row_to_split,
+)
+
+__all__ = [
+    "__version__",
+    "embedding_lookup",
+    "row_to_split",
+    "Ragged",
+    "SparseIds",
+]

@@ -1,0 +1,4 @@
+"""Module layer (TPU equivalent of the reference's Keras layers,
+``distributed_embeddings/python/layers/``)."""
+
+from .embedding import ConcatEmbedding, Embedding

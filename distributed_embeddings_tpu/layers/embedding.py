@@ -1,0 +1,151 @@
+"""Embedding modules (Flax) with the reference layer's shape semantics.
+
+TPU re-design of ``distributed_embeddings/python/layers/embedding.py:41-183``:
+the Keras ``Embedding``/``ConcatEmbedding`` layers become Flax ``nn.Module``s
+over the functional :func:`~distributed_embeddings_tpu.ops.embedding_lookup`.
+
+Differences from the reference, by design:
+
+* Initialization on huge tables: the reference forces init onto the CPU device
+  to dodge GPU OOM (``embedding.py:28-38``). Here initializers are ordinary
+  ``jax.nn.initializers`` callables; sharded/host init for oversized tables is
+  handled where sharding is known — in the distributed wrapper — not here.
+* ``get_config``/``from_config`` carry plain dicts (used by the planner the
+  same way the reference strategy consumes Keras configs,
+  ``dist_model_parallel.py:44``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.embedding_lookup import Ragged, SparseIds, embedding_lookup
+
+Initializer = Callable[..., jax.Array]
+
+# Keras's 'uniform' default: RandomUniform(-0.05, 0.05).
+def default_embeddings_init(key, shape, dtype=jnp.float32):
+    return jax.random.uniform(key, shape, dtype, minval=-0.05, maxval=0.05)
+
+
+class Embedding(nn.Module):
+    """Turns ids into fixed-width vectors, with optional multi-hot reduction.
+
+    Parity surface (reference ``embedding.py:41-133``):
+
+    * dense N-D input, ``combiner=None`` → output ``(..., output_dim)``
+    * dense N-D input (N>=2) + combiner → reduced over the last dim
+      → output ``(d1, ..., dn-1, output_dim)``
+    * 1-D dense input + combiner raises (ambiguous, as in the reference)
+    * 2-D :class:`Ragged` / :class:`SparseIds` + combiner → ``(batch, output_dim)``
+
+    Attributes:
+      input_dim: vocabulary size.
+      output_dim: embedding width.
+      embeddings_initializer: flax-style initializer ``f(key, shape, dtype)``.
+      combiner: ``None``, ``'sum'`` or ``'mean'``.
+      param_dtype: dtype of the table.
+      dtype: compute/output dtype (casts after lookup, pre-reduction happens in
+        param dtype like the reference's no-autocast policy, ``embedding.py:82``).
+    """
+
+    input_dim: int
+    output_dim: int
+    embeddings_initializer: Initializer = default_embeddings_init
+    combiner: Optional[str] = None
+    param_dtype: Any = jnp.float32
+    dtype: Optional[Any] = None
+
+    def setup(self):
+        if self.input_dim <= 0 or self.output_dim <= 0:
+            raise ValueError(
+                "Both input_dim and output_dim should be positive, "
+                f"found {self.input_dim} and {self.output_dim}")
+        self.embeddings = self.param(
+            "embeddings", self.embeddings_initializer,
+            (self.input_dim, self.output_dim), self.param_dtype)
+
+    def __call__(self, inputs):
+        out = self.lookup(self.embeddings, inputs)
+        if self.dtype is not None:
+            out = out.astype(self.dtype)
+        return out
+
+    def lookup(self, table: jax.Array, inputs) -> jax.Array:
+        """Pure lookup used by both this module and the distributed wrapper."""
+        if isinstance(inputs, (Ragged, SparseIds)):
+            if self.combiner is None:
+                raise ValueError("Ragged/sparse input requires a combiner")
+            return embedding_lookup(table, inputs, combiner=self.combiner)
+        inputs = jnp.asarray(inputs)
+        if not jnp.issubdtype(inputs.dtype, jnp.integer):
+            inputs = inputs.astype(jnp.int32)
+        if inputs.ndim == 1:
+            if self.combiner is not None:
+                raise ValueError(
+                    "1D input with combiner is ambiguous. Please create batch dimension.")
+            return embedding_lookup(table, inputs)
+        if self.combiner is None:
+            return embedding_lookup(table, inputs)
+        # combiner reduces the trailing dimension; flatten leading dims like the
+        # reference's non-2D reshape (embedding.py:115-132)
+        lead = inputs.shape[:-1]
+        flat = inputs.reshape(-1, inputs.shape[-1])
+        out = embedding_lookup(table, flat, combiner=self.combiner)
+        return out.reshape(lead + (self.output_dim,))
+
+    def get_config(self) -> Dict[str, Any]:
+        return {
+            "input_dim": self.input_dim,
+            "output_dim": self.output_dim,
+            "embeddings_initializer": self.embeddings_initializer,
+            "combiner": self.combiner,
+            "param_dtype": self.param_dtype,
+            "dtype": self.dtype,
+        }
+
+    @classmethod
+    def from_config(cls, config: Dict[str, Any]) -> "Embedding":
+        """Build from a config dict; ignores Keras-only keys the way the
+        reference's override does (``embedding.py:148-155``)."""
+        config = {k: v for k, v in config.items()
+                  if k not in ("mask_zero", "input_length", "name")}
+        return cls(**config)
+
+
+class ConcatEmbedding(nn.Module):
+    """Many same-width one-hot tables fused into one weight matrix with row
+    offsets; lookup is a single gather of ``input + offsets``
+    (reference ``embedding.py:158-183``).
+
+    Input: ``[batch, num_tables]`` ids, one per table.
+    Output: ``[batch, num_tables, embedding_width]``.
+    """
+
+    feature_sizes: tuple
+    embedding_width: int
+    embeddings_initializer: Initializer = default_embeddings_init
+    param_dtype: Any = jnp.float32
+
+    def setup(self):
+        total = int(sum(self.feature_sizes))
+        self.params_matrix = self.param(
+            "embeddings", self.embeddings_initializer,
+            (total, self.embedding_width), self.param_dtype)
+
+    @property
+    def offsets(self) -> jax.Array:
+        import numpy as np
+        off = np.concatenate([[0], np.cumsum(self.feature_sizes)])
+        return jnp.asarray(off, jnp.int32)
+
+    def __call__(self, inputs):
+        if inputs.shape[1] != len(self.feature_sizes):
+            raise ValueError(
+                f"Expected {len(self.feature_sizes)} id columns, got {inputs.shape[1]}")
+        idx = inputs + self.offsets[:-1]
+        return jnp.take(self.params_matrix, idx, axis=0, mode="clip")

@@ -1,0 +1,87 @@
+"""Sparse (IndexedSlices-style) embedding gradients, TPU-native.
+
+The reference's backward kernel (``cc/kernels/embedding_lookup_kernels.cu:457-629``)
+turns per-output-row gradients into ``(unique_ids, unique_grad)`` via CUB
+radix-sort + unique-by-key, wrapped as ``tf.IndexedSlices``
+(``python/ops/embedding_lookup_ops.py:105-122``). On TPU we reproduce the same
+dataflow with static shapes:
+
+* :func:`combiner_grad_values` — expand a ``[batch, width]`` output cotangent
+  to per-id row gradients (the ``OffsetToWeightsAndRowId`` + weighted-reuse
+  trick of the reference backward, ``.cu:493-494,539-627``).
+* :func:`dedup_sparse_grad` — sort ids, segment-sum duplicate rows; output
+  buffers keep the input capacity (the dynamic ``num_unique`` of the reference,
+  ``.cu:519-528``, becomes a pad-id sentinel + ``mode='drop'`` scatters).
+
+Deduplication is only *required* by optimizers whose update is nonlinear in the
+gradient (Adagrad/Adam); plain SGD can scatter-add duplicates directly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .embedding_lookup import ragged_row_ids
+
+
+def combiner_grad_values(out_grad: jax.Array, row_splits: jax.Array,
+                         capacity: int, combiner: str) -> jax.Array:
+    """Per-id gradient rows for a CSR lookup-with-combiner.
+
+    Args:
+      out_grad: ``[batch, width]`` cotangent of the combined output.
+      row_splits: ``[batch+1]`` CSR offsets of the forward input.
+      capacity: static id capacity of the forward input.
+      combiner: ``'sum'`` or ``'mean'``.
+
+    Returns:
+      ``[capacity, width]`` gradient for each id position (zeros at padding).
+    """
+    seg = ragged_row_ids(row_splits, capacity)
+    vals = jnp.take(out_grad, seg, axis=0, mode="fill", fill_value=0)
+    if combiner == "mean":
+        counts = (row_splits[1:] - row_splits[:-1]).astype(out_grad.dtype)
+        inv = 1.0 / jnp.maximum(counts, 1)
+        per_id = jnp.take(inv, seg, mode="fill", fill_value=0)
+        vals = vals * per_id[:, None]
+    return vals
+
+
+def dedup_sparse_grad(ids: jax.Array, grads: jax.Array, *,
+                      pad_id: int,
+                      valid: Optional[jax.Array] = None
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Sort ids and sum gradient rows of duplicates.
+
+    Args:
+      ids: ``[n]`` int row ids; entries equal to (or marked invalid via
+        ``valid=False``) are treated as padding.
+      grads: ``[n, width]`` per-id gradient rows.
+      pad_id: sentinel for padding/unused output slots. Must be >= vocab so
+        that ``.at[ids].op(..., mode='drop')`` ignores those rows.
+      valid: optional ``[n]`` bool mask; invalid entries are replaced by
+        ``pad_id`` before sorting.
+
+    Returns:
+      ``(unique_ids, unique_grads)`` with the same ``[n]``/``[n, width]``
+      shapes: position ``k < num_unique`` holds the k-th smallest unique id and
+      the sum of its gradient rows; positions past that hold ``pad_id`` and
+      garbage (callers scatter with ``mode='drop'``).
+    """
+    n = ids.shape[0]
+    if valid is not None:
+        ids = jnp.where(valid, ids, pad_id)
+    sorted_ids, perm = jax.lax.sort_key_val(ids, jnp.arange(n, dtype=jnp.int32))
+    sorted_grads = jnp.take(grads, perm, axis=0)
+    boundary = jnp.concatenate(
+        [jnp.ones((1,), jnp.int32),
+         (sorted_ids[1:] != sorted_ids[:-1]).astype(jnp.int32)])
+    seg = jnp.cumsum(boundary) - 1  # [n], segment index per sorted row
+    unique_grads = jnp.zeros_like(sorted_grads).at[seg].add(sorted_grads, mode="drop")
+    unique_ids = jnp.full((n,), pad_id, dtype=ids.dtype).at[seg].set(sorted_ids, mode="drop")
+    # Padding ids sort last and get their own segment(s) holding pad_id: dropped
+    # downstream by the same out-of-range rule the scatters here rely on.
+    return unique_ids, unique_grads

@@ -1,0 +1,16 @@
+"""Hybrid model/data parallelism over a TPU mesh.
+
+TPU-native re-design of ``distributed_embeddings/python/layers/dist_model_parallel.py``:
+the placement planner is pure Python (carried over algorithmically), while the
+runtime communication (Horovod all-to-all/allreduce in the reference) becomes
+``jax.lax`` collectives inside ``jax.shard_map`` over a named mesh axis.
+"""
+
+from .strategy import DistEmbeddingStrategy
+from .dist_embedding import DistributedEmbedding
+from .grads import (
+    broadcast_variables,
+    hybrid_gradients,
+    hybrid_value_and_grad,
+    split_mp_dp,
+)

@@ -1,0 +1,3 @@
+"""Package version (reference: version.txt)."""
+
+__version__ = "0.1.0"

@@ -1,0 +1,22 @@
+"""Test harness: force an 8-virtual-device CPU platform.
+
+The reference test suite needs real GPUs under ``horovodrun -np N``
+(``distributed_embeddings/python/layers/dist_model_parallel_test.py:85-89``);
+here multi-device tests run anywhere via XLA's host-platform device count —
+a capability called out in SURVEY.md §4 as worth having from day 1.
+
+Must run before the first JAX backend initialization. The container's
+sitecustomize may have already *registered* a TPU plugin at interpreter start;
+switching ``jax_platforms`` to cpu before any backend is touched still works.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)

@@ -1,0 +1,125 @@
+"""Planner unit tests (reference behavior:
+``dist_model_parallel.py:25-196``)."""
+
+import pytest
+
+from distributed_embeddings_tpu.parallel.strategy import (
+    DistEmbeddingStrategy,
+    apply_strategy,
+    maybe_slice_table_column,
+)
+
+
+def cfg(rows, width):
+    return {"input_dim": rows, "output_dim": width}
+
+
+def test_no_slice_below_threshold():
+    assert maybe_slice_table_column(cfg(10, 4), 100, 8) == [cfg(10, 4)]
+    assert maybe_slice_table_column(cfg(10, 4), None, 8) == [cfg(10, 4)]
+
+
+def test_power_of_two_slicing_with_remainder():
+    # 1000x10 = 10000 elements, threshold 3000 -> 4 slices, capped by nothing
+    slices = maybe_slice_table_column(cfg(1000, 10), 3000, 8)
+    assert [s["output_dim"] for s in slices] == [3, 3, 2, 2]
+    assert all(s["input_dim"] == 1000 for s in slices)
+
+
+def test_slice_caps():
+    # would want 8 slices but width is 4 -> capped at 4
+    slices = maybe_slice_table_column(cfg(1000, 4), 600, 8)
+    assert len(slices) == 4
+    # capped by world size
+    slices = maybe_slice_table_column(cfg(1000, 8), 600, 2)
+    assert len(slices) == 2
+
+
+def test_basic_round_robin():
+    sliced = [[cfg(10, 2)] for _ in range(5)]
+    ids = apply_strategy("basic", 2, sliced)
+    assert ids == [[0, 2, 4], [1, 3]]
+
+
+def test_memory_balanced_snake():
+    sizes = [100, 90, 80, 70, 60, 50, 40, 30]
+    sliced = [[cfg(s, 1)] for s in sizes]
+    ids = apply_strategy("memory_balanced", 2, sliced)
+    # table counts even, byte loads close
+    assert sorted(len(r) for r in ids) == [4, 4]
+    loads = [sum(sizes[t] for t in r) for r in ids]
+    assert abs(loads[0] - loads[1]) <= 20
+    assert sorted(ids[0] + ids[1]) == list(range(8))
+
+
+def test_memory_optimized_greedy():
+    sizes = [100, 1, 1, 1, 1, 1]
+    sliced = [[cfg(s, 1)] for s in sizes]
+    ids = apply_strategy("memory_optimized", 2, sliced)
+    loads = sorted(sum(sizes[t] for t in r) for r in ids)
+    assert loads == [5, 100]
+
+
+def test_unknown_strategy_raises():
+    with pytest.raises(ValueError):
+        DistEmbeddingStrategy([cfg(4, 2)], 1, strategy="bogus")
+
+
+def test_world_one_passthrough():
+    s = DistEmbeddingStrategy([cfg(4, 2), cfg(6, 3)], 1)
+    assert s.table_ids_list == [[0, 1]]
+    assert s.local_input_table_map == [0, 1]
+    assert s.rev_global_input_ids == [0, 1]
+    assert s.widths_list_flat == [2, 3]
+
+
+def test_global_view_consistency():
+    configs = [cfg(100, 8), cfg(50, 4), cfg(80, 8), cfg(10, 2), cfg(60, 4)]
+    s = DistEmbeddingStrategy(configs, 2, strategy="basic")
+    # every table placed exactly once
+    placed = sorted(t for r in s.table_ids_list for t in r)
+    assert placed == list(range(5))
+    # every input routed exactly once, reorder is a permutation
+    routed = sorted(i for r in s.input_ids_list for i in r)
+    assert routed == list(range(5))
+    assert sorted(s.rev_global_input_ids) == list(range(5))
+    # widths in worker order match the routed inputs' table widths
+    flat_inputs = [i for r in s.input_ids_list for i in r]
+    assert s.widths_list_flat == [
+        configs[s.input_table_map[i]]["output_dim"] for i in flat_inputs]
+
+
+def test_shared_table_inputs():
+    # inputs 0,1 -> table 0; input 2 -> table 1
+    s = DistEmbeddingStrategy([cfg(10, 4), cfg(20, 8)], 2,
+                              input_table_map=[0, 0, 1])
+    routed = sorted(i for r in s.input_ids_list for i in r)
+    assert routed == [0, 1, 2]
+    # the rank owning table 0 sees both inputs with the same local table
+    for rank_ids, rank_map in zip(s.input_ids_list, s.local_map_list):
+        if 0 in rank_ids:
+            assert 1 in rank_ids
+            m0 = rank_map[rank_ids.index(0)]
+            assert rank_map[rank_ids.index(1)] == m0
+
+
+def test_column_slice_out_ranges_collapse():
+    # table 0 sliced in 2; ranges expressed in progressive-collapse coordinates
+    configs = [cfg(100, 8), cfg(10, 2), cfg(10, 2)]
+    s = DistEmbeddingStrategy(configs, 2, column_slice_threshold=400)
+    assert s.sliced_out_ranges == [[0, 2]]
+    # four outputs before collapse: two slices of input 0 + inputs 1,2
+    assert len(s.rev_global_input_ids) == 4
+    # reordered outputs are sorted by input id: first two belong to input 0
+    flat_inputs = [i for r in s.input_ids_list for i in r]
+    reordered = [flat_inputs[i] for i in s.rev_global_input_ids]
+    assert reordered == sorted(flat_inputs) == [0, 0, 1, 2]
+
+
+def test_column_slice_widths_sum():
+    configs = [cfg(1000, 9)]
+    s = DistEmbeddingStrategy(configs, 4, column_slice_threshold=3000)
+    widths = [c["output_dim"] for c in
+              (s.local_configs_list[0] + s.local_configs_list[1] +
+               s.local_configs_list[2] + s.local_configs_list[3])]
+    assert sum(widths) == 9 and len(widths) == 4
