@@ -1,0 +1,101 @@
+"""Headline benchmark: DLRM train-step throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": "dlrm_samples_per_sec_per_chip", "value": N, "unit": "samples/s",
+   "vs_baseline": N}
+
+Config mirrors the reference's DLRM example (``examples/dlrm/``: MLPerf DLRM,
+26 categorical features, embedding dim 128, bottom MLP 512-256-128, top MLP
+1024-1024-512-256-1, SGD, global batch 65536) with Criteo-Kaggle-like vocab
+sizes frequency-capped at 2M rows so the tables (~5.4 GB fp32) fit a single
+chip's HBM — the single-chip slice of the Criteo-1TB target.
+
+Baseline: the north-star from BASELINE.json — DLRM Criteo-1TB at >=2M
+samples/s on v5e-16, i.e. 125k samples/s/chip. vs_baseline = value / 125000.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributed_embeddings_tpu.models.dlrm import (
+    DLRMConfig, DLRMDense, bce_with_logits)
+from distributed_embeddings_tpu.parallel import (
+    DistributedEmbedding, HybridTrainState, SparseSGD, make_hybrid_train_step)
+from distributed_embeddings_tpu.utils import power_law_ids
+
+CRITEO_KAGGLE_SIZES = [
+    1460, 583, 10131227, 2202608, 305, 24, 12517, 633, 3, 93145, 5683,
+    8351593, 3194, 27, 14992, 5461306, 10, 5652, 2173, 4, 7046547, 18, 15,
+    286181, 105, 142572,
+]
+CAP = 2_000_000
+BATCH = 65536
+BASELINE_SAMPLES_PER_SEC_PER_CHIP = 125_000.0
+
+
+def main():
+    table_sizes = [min(s, CAP) for s in CRITEO_KAGGLE_SIZES]
+    cfg = DLRMConfig(table_sizes=table_sizes, embedding_dim=128,
+                     num_numerical_features=13,
+                     bottom_mlp_dims=(512, 256, 128),
+                     top_mlp_dims=(1024, 1024, 512, 256, 1))
+
+    de = DistributedEmbedding(cfg.embedding_configs(), world_size=1)
+    dense = DLRMDense(cfg)
+    emb_opt = SparseSGD()
+    tx = optax.sgd(0.005)
+
+    rng = np.random.default_rng(0)
+    num = jnp.asarray(rng.normal(size=(BATCH, 13)), jnp.float32)
+    cats = [jnp.asarray(power_law_ids(rng, s, (BATCH,)), jnp.int32)
+            for s in table_sizes]
+    labels = jnp.asarray(rng.integers(0, 2, size=(BATCH, 1)), jnp.float32)
+
+    dense_params = dense.init(
+        jax.random.key(0), num[:2],
+        [jnp.zeros((2, cfg.embedding_dim), jnp.float32) for _ in table_sizes])
+
+    flat = de.init(jax.random.key(1))
+    state = HybridTrainState(
+        emb_params=flat,
+        emb_opt_state=emb_opt.init(flat),
+        dense_params=dense_params,
+        dense_opt_state=tx.init(dense_params),
+        step=jnp.zeros((), jnp.int32))
+
+    def loss_fn(dp, emb_outs, batch):
+        n, y = batch
+        return bce_with_logits(dense.apply(dp, n, emb_outs), y)
+
+    step_fn = make_hybrid_train_step(de, loss_fn, tx, emb_opt,
+                                     lr_schedule=0.005)
+
+    # warmup / compile
+    for _ in range(3):
+        loss, state = step_fn(state, cats, (num, labels))
+    jax.block_until_ready(loss)
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss, state = step_fn(state, cats, (num, labels))
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / iters
+
+    samples_per_sec = BATCH / dt
+    print(json.dumps({
+        "metric": "dlrm_samples_per_sec_per_chip",
+        "value": round(samples_per_sec, 1),
+        "unit": "samples/s",
+        "vs_baseline": round(samples_per_sec /
+                             BASELINE_SAMPLES_PER_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,5 @@
+"""Model zoo (TPU equivalents of the reference's examples: DLRM and the
+synthetic benchmark models)."""
+
+from .dlrm import DLRM, DLRMConfig, dlrm_initializer, dot_interact
+from .schedules import warmup_poly_decay_schedule

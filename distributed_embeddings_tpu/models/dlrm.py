@@ -1,0 +1,163 @@
+"""DLRM (MLPerf-style) for TPU.
+
+TPU re-design of the reference example (``examples/dlrm/main.py:76-147`` and
+``examples/dlrm/utils.py:27-113``): bottom MLP over dense features, one
+embedding per categorical feature, pairwise dot-product interaction, top MLP
+to a single logit. The dense half is a Flax module (data-parallel); the
+embedding half is fed in as activations so it can come from either local
+tables or a :class:`~distributed_embeddings_tpu.parallel.DistributedEmbedding`
+— mirroring how the reference swaps local Keras embeddings for the
+distributed wrapper (``main.py:95-98``).
+
+TPU notes: interaction and MLPs run in bf16-friendly matmuls shaped for the
+MXU (the dot-interaction is one batched ``[B, F, D] @ [B, D, F]``); the
+lower-triangle extraction uses a static mask + reshape, no dynamic shapes.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def dlrm_initializer(rows: int):
+    """Uniform(-1/sqrt(rows), +1/sqrt(rows)) table initializer
+    (reference ``DLRMInitializer``, ``examples/dlrm/utils.py:27-41``)."""
+
+    def init(key, shape, dtype=jnp.float32):
+        maxval = 1.0 / math.sqrt(rows)
+        return jax.random.uniform(key, shape, dtype, -maxval, maxval)
+
+    return init
+
+
+def dot_interact(emb_outs: Sequence[jax.Array],
+                 bottom_mlp_out: jax.Array) -> jax.Array:
+    """Pairwise dot-product feature interaction
+    (reference ``dot_interact``, ``examples/dlrm/utils.py:92-113``).
+
+    Stacks ``[bottom_mlp_out] + emb_outs`` into ``[B, F, D]``, takes the
+    strictly-lower-triangular entries of the ``[B, F, F]`` Gram matrix, and
+    concatenates the bottom-MLP output back on.
+    """
+    feats = jnp.stack([bottom_mlp_out] + list(emb_outs), axis=1)  # [B, F, D]
+    gram = jnp.einsum("bfd,bgd->bfg", feats, feats)
+    f = feats.shape[1]
+    li, lj = jnp.tril_indices(f, k=-1)
+    lower = gram[:, li, lj]  # [B, F*(F-1)/2], static index gather
+    return jnp.concatenate([lower, bottom_mlp_out], axis=1)
+
+
+class DLRMConfig:
+    """Model hyperparameters (reference flags, ``examples/dlrm/main.py:32-59``)."""
+
+    def __init__(self,
+                 table_sizes: Sequence[int] = (1000,) * 26,
+                 embedding_dim: int = 128,
+                 num_numerical_features: int = 13,
+                 bottom_mlp_dims: Sequence[int] = (512, 256, 128),
+                 top_mlp_dims: Sequence[int] = (1024, 1024, 512, 256, 1),
+                 compute_dtype: Any = jnp.float32):
+        if bottom_mlp_dims[-1] != embedding_dim:
+            raise ValueError(
+                "bottom MLP must project to embedding_dim for dot interaction")
+        self.table_sizes = list(table_sizes)
+        self.embedding_dim = embedding_dim
+        self.num_numerical_features = num_numerical_features
+        self.bottom_mlp_dims = list(bottom_mlp_dims)
+        self.top_mlp_dims = list(top_mlp_dims)
+        self.compute_dtype = compute_dtype
+
+    def embedding_configs(self, combiner: Optional[str] = None):
+        """Table configs for DistributedEmbedding / Embedding layers."""
+        return [{
+            "input_dim": int(s),
+            "output_dim": self.embedding_dim,
+            "combiner": combiner,
+            "embeddings_initializer": dlrm_initializer(int(s)),
+        } for s in self.table_sizes]
+
+
+class DLRMDense(nn.Module):
+    """The data-parallel half: bottom MLP -> dot interaction -> top MLP.
+
+    Takes embedding activations as inputs (one ``[B, D]`` per table) so the
+    embedding half can be local or distributed.
+    """
+
+    config: DLRMConfig
+
+    @nn.compact
+    def __call__(self, numerical_features: jax.Array,
+                 embedding_outputs: Sequence[jax.Array]) -> jax.Array:
+        cfg = self.config
+        dt = cfg.compute_dtype
+        x = numerical_features.astype(dt)
+        for dim in cfg.bottom_mlp_dims:
+            x = nn.Dense(
+                dim, dtype=dt,
+                kernel_init=nn.initializers.glorot_normal(),
+                bias_init=nn.initializers.normal(math.sqrt(1.0 / dim)))(x)
+            x = nn.relu(x)
+        embs = [e.astype(dt) for e in embedding_outputs]
+        y = dot_interact(embs, x)
+        for dim in cfg.top_mlp_dims[:-1]:
+            y = nn.Dense(
+                dim, dtype=dt,
+                kernel_init=nn.initializers.glorot_normal(),
+                bias_init=nn.initializers.normal(math.sqrt(1.0 / dim)))(y)
+            y = nn.relu(y)
+        y = nn.Dense(
+            cfg.top_mlp_dims[-1], dtype=jnp.float32,
+            kernel_init=nn.initializers.glorot_normal(),
+            bias_init=nn.initializers.normal(
+                math.sqrt(1.0 / cfg.top_mlp_dims[-1])))(y)
+        return y
+
+
+class DLRM:
+    """Full model: local (single-device) embedding tables + DLRMDense.
+
+    For the distributed version, pair :class:`DLRMDense` with
+    :class:`~distributed_embeddings_tpu.parallel.DistributedEmbedding` over
+    ``config.embedding_configs()`` (see ``examples/dlrm/main.py`` here and in
+    the reference).
+    """
+
+    def __init__(self, config: DLRMConfig):
+        self.config = config
+        self.dense = DLRMDense(config)
+
+    def init(self, key) -> dict:
+        kt, kd = jax.random.split(key)
+        cfg = self.config
+        tables = []
+        for i, size in enumerate(cfg.table_sizes):
+            tables.append(dlrm_initializer(size)(
+                jax.random.fold_in(kt, i), (size, cfg.embedding_dim)))
+        dense_params = self.dense.init(
+            kd,
+            jnp.zeros((2, cfg.num_numerical_features), jnp.float32),
+            [jnp.zeros((2, cfg.embedding_dim), jnp.float32)
+             for _ in cfg.table_sizes])
+        return {"tables": tables, "dense": dense_params}
+
+    def apply(self, params, numerical_features, categorical_features):
+        embs = [jnp.take(t, ids.reshape(-1), axis=0)
+                for t, ids in zip(params["tables"], categorical_features)]
+        return self.dense.apply(params["dense"], numerical_features, embs)
+
+
+def bce_with_logits(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean binary cross-entropy from logits (reference uses
+    ``tf.keras.losses.BinaryCrossentropy(from_logits=True)``,
+    ``examples/dlrm/main.py:198-199``)."""
+    logits = logits.reshape(-1)
+    labels = labels.reshape(-1).astype(logits.dtype)
+    return jnp.mean(jnp.clip(logits, 0) - logits * labels +
+                    jnp.log1p(jnp.exp(-jnp.abs(logits))))

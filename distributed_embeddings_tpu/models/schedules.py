@@ -1,0 +1,31 @@
+"""Learning-rate schedules.
+
+TPU equivalent of the reference's ``LearningRateScheduler``
+(``examples/dlrm/utils.py:45-88``): linear warmup, constant plateau, then
+polynomial (power-2) decay. The reference mutates ``optimizer.lr`` via a tf
+Variable each step; in JAX a schedule is a pure ``step -> lr`` function usable
+both by optax and by the sparse embedding optimizers.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def warmup_poly_decay_schedule(base_lr: float, warmup_steps: int,
+                               decay_start_step: int, decay_steps: int,
+                               poly_power: int = 2):
+    """``step -> lr``: ramp 0→base over ``warmup_steps``, hold, then decay to 0
+    over ``decay_steps`` with ``(remaining/decay_steps)**poly_power``."""
+    decay_end_step = decay_start_step + decay_steps
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warmup = 1.0 - (warmup_steps - step) / warmup_steps
+        decay = jnp.clip(
+            (decay_end_step - step) / decay_steps, 0.0, 1.0) ** poly_power
+        factor = jnp.where(step < warmup_steps, warmup,
+                           jnp.where(step < decay_start_step, 1.0, decay))
+        return base_lr * factor
+
+    return schedule

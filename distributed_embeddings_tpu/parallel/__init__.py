@@ -12,5 +12,8 @@ from .grads import (
     broadcast_variables,
     hybrid_gradients,
     hybrid_value_and_grad,
+    resolve_dp_gradient,
     split_mp_dp,
 )
+from .optimizers import SparseAdagrad, SparseSGD
+from .trainer import HybridTrainState, init_hybrid_state, make_hybrid_train_step

@@ -10,11 +10,15 @@ JAX SPMD instead of Horovod MPMD:
   GPU, each building only its local tables. Here a single program runs on every
   device inside ``jax.shard_map``; per-rank table heterogeneity is expressed as
   ``lax.switch`` over rank-specialized lookup branches, each with fully static
-  shapes (table slice offsets, hotness, widths) so XLA tiles them onto the MXU.
-* **Parameters as one sharded buffer.** Each rank's tables live row-major in a
-  flat ``[capacity]`` slab; the global parameter is ``[world, capacity]``
-  sharded over the mesh axis. This replaces per-rank ``tf.Variable`` lists and
-  makes checkpointing/optimizers uniform.
+  shapes (table row offsets, hotness, widths) so XLA tiles them onto the MXU.
+* **Parameters as width-grouped stacked tables.** Each rank's tables of width
+  ``w`` stack row-major into one 2-D slab; the global parameter is a dict
+  ``{width: [world, rows_cap_w, w]}`` sharded over the mesh axis. Stacking by
+  width keeps every embedding read/update a native 2-D row gather/scatter —
+  the layout XLA's TPU backend has fast paths for (1-D element/windowed
+  scatters lower to a serialized path, ~30x slower end-to-end) — and gives
+  SPMD-uniform pytree shapes across ranks (padding rows absorb imbalance).
+  This replaces the reference's per-rank ``tf.Variable`` lists.
 * **Collectives.** ``hvd.alltoall(splits=...)`` (variable splits,
   ``dist_model_parallel.py:282``) has no ragged JAX primitive on every backend,
   so id blocks are padded to the max per-rank split and exchanged with
@@ -31,16 +35,18 @@ matching the reference's dense-only ``_call_base`` (``:261-311``).
 from __future__ import annotations
 
 import functools
-from typing import Any, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..layers.embedding import Embedding, default_embeddings_init
+from ..layers.embedding import default_embeddings_init
 from ..ops.embedding_lookup import embedding_lookup
 from .strategy import DistEmbeddingStrategy
+
+EmbedParams = Dict[str, jax.Array]
 
 
 def _out_width(config, hotness: int) -> int:
@@ -49,6 +55,18 @@ def _out_width(config, hotness: int) -> int:
     ``dist_model_parallel.py:297,307``)."""
     w = int(config["output_dim"])
     return w if config.get("combiner") else w * hotness
+
+
+def _wkey(width: int) -> str:
+    return f"w{width}"
+
+
+def _pvary(x: jax.Array, axis_name: str) -> jax.Array:
+    """Mark a constant as device-varying over ``axis_name`` so it can join
+    varying values in collectives/switch branches under VMA typing."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis_name, to="varying")
+    return lax.pvary(x, axis_name)
 
 
 class DistributedEmbedding:
@@ -100,48 +118,69 @@ class DistributedEmbedding:
                 "Fewer tables than mesh positions is not supported "
                 "(reference constraint, dist_model_parallel.py:252-253)")
 
-        # Row-major layout of each rank's tables inside its flat slab.
-        self.local_offsets_list: List[List[int]] = []
-        sizes = []
+        # slice multiplicity per global table (column slicing)
+        self._slices_per_table = [0] * len(self.strategy.global_configs)
+        for rank_ids in self.strategy.table_ids_list:
+            for tid in rank_ids:
+                self._slices_per_table[tid] += 1
+
+        # Width-grouped stacked-table layout: per rank, tables of equal width
+        # stack row-major into one 2-D slab; slab row capacity is the max over
+        # ranks so the params pytree is SPMD-uniform.
+        widths = sorted({int(c["output_dim"])
+                         for cfgs in self.strategy.local_configs_list
+                         for c in cfgs})
+        self.widths: List[int] = widths
+        # row_offsets_list[rank][m] = first row of local table m in its slab
+        self.row_offsets_list: List[List[int]] = []
+        per_rank_rows = []  # [rank][width] -> rows used
         for cfgs in self.strategy.local_configs_list:
-            offsets, acc = [], 0
+            used = {w: 0 for w in widths}
+            offsets = []
             for c in cfgs:
-                offsets.append(acc)
-                acc += int(c["input_dim"]) * int(c["output_dim"])
-            self.local_offsets_list.append(offsets)
-            sizes.append(acc)
-        self.capacity = max(max(sizes), 1)
+                w = int(c["output_dim"])
+                offsets.append(used[w])
+                used[w] += int(c["input_dim"])
+            self.row_offsets_list.append(offsets)
+            per_rank_rows.append(used)
+        self.rows_cap: Dict[int, int] = {
+            w: max(max(r[w] for r in per_rank_rows), 1) for w in widths}
 
     # ------------------------------------------------------------------ params
 
-    def _init_rank_flat(self, key, rank: int, dtype) -> jax.Array:
-        """Initialize one rank's slab: per-table initializers, flattened and
-        concatenated; column slices are initialized independently like the
-        reference's per-slice layers (``dist_model_parallel.py:256-259``)."""
+    def _init_rank_width(self, key, rank: int, width: int, dtype) -> jax.Array:
+        """One rank's slab for one width: per-table initializers stacked
+        row-major; column slices initialize independently like the reference's
+        per-slice layers (``dist_model_parallel.py:256-259``)."""
         cfgs = self.strategy.local_configs_list[rank]
-        keys = jax.random.split(key, max(len(cfgs), 1))
         parts = []
-        for cfg, k in zip(cfgs, keys):
+        for m, cfg in enumerate(cfgs):
+            if int(cfg["output_dim"]) != width:
+                continue
             init = cfg.get("embeddings_initializer") or default_embeddings_init
-            shape = (int(cfg["input_dim"]), int(cfg["output_dim"]))
-            parts.append(init(k, shape, dtype).reshape(-1))
-        flat = jnp.concatenate(parts) if parts else jnp.zeros((0,), dtype)
-        pad = self.capacity - flat.shape[0]
+            shape = (int(cfg["input_dim"]), width)
+            parts.append(init(jax.random.fold_in(key, m), shape, dtype))
+        total = sum(p.shape[0] for p in parts)
+        pad = self.rows_cap[width] - total
         if pad:
-            flat = jnp.concatenate([flat, jnp.zeros((pad,), dtype)])
-        return flat
+            parts.append(jnp.zeros((pad, width), dtype))
+        return jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
 
-    def init(self, key, dtype=jnp.float32, mesh=None) -> jax.Array:
-        """Build the global ``[world, capacity]`` parameter buffer.
+    def init(self, key, dtype=jnp.float32, mesh=None) -> EmbedParams:
+        """Build the global param dict ``{width: [world, rows_cap, width]}``.
 
-        With ``mesh`` given, the result is laid out sharded over
-        ``(axis_name,)`` so each rank's slab materializes on its own device.
+        With ``mesh`` given, slabs are laid out sharded over ``(axis_name,)``
+        so each rank's rows materialize on its own device.
         """
         keys = jax.random.split(key, self.world_size)
 
         def build():
-            return jnp.stack([self._init_rank_flat(keys[r], r, dtype)
-                              for r in range(self.world_size)])
+            out = {}
+            for w in self.widths:
+                out[_wkey(w)] = jnp.stack([
+                    self._init_rank_width(keys[r], r, w, dtype)
+                    for r in range(self.world_size)])
+            return out
 
         if mesh is None:
             return jax.jit(build)()
@@ -149,16 +188,31 @@ class DistributedEmbedding:
             mesh, jax.sharding.PartitionSpec(self.axis_name))
         return jax.jit(build, out_shardings=sharding)()
 
-    def local_table(self, flat_local: jax.Array, rank: int, m: int) -> jax.Array:
-        """Static view of local table ``m`` of ``rank`` inside its slab."""
+    def local_view(self, params: EmbedParams) -> EmbedParams:
+        """Squeeze the leading world axis of per-device slabs
+        (``[1, rows, w]`` inside shard_map / world_size==1 → ``[rows, w]``)."""
+        return {k: (v.reshape(v.shape[-2], v.shape[-1])
+                    if hasattr(v, "ndim") and v.ndim == 3 else v)
+                for k, v in params.items()}
+
+    def stacked_view(self, params: EmbedParams) -> EmbedParams:
+        """Re-add the leading world axis for P(axis) out_specs."""
+        return {k: (v.reshape(1, *v.shape)
+                    if hasattr(v, "ndim") and v.ndim == 2 else v)
+                for k, v in params.items()}
+
+    def _table_rows(self, rank: int, m: int):
         cfg = self.strategy.local_configs_list[rank][m]
-        rows, width = int(cfg["input_dim"]), int(cfg["output_dim"])
-        off = self.local_offsets_list[rank][m]
-        return lax.slice(flat_local, (off,), (off + rows * width,)).reshape(rows, width)
+        w = int(cfg["output_dim"])
+        roff = self.row_offsets_list[rank][m]
+        return _wkey(w), roff, int(cfg["input_dim"]), w
 
     # ----------------------------------------------------------------- forward
 
-    def _normalize_inputs(self, inputs) -> List[jax.Array]:
+    def _normalize_inputs(self, inputs):
+        """Promote to a common int dtype and 2-D ``[batch, hotness]``; track
+        which inputs were 1-D so local lookups can preserve the reference's
+        1-D output shape (``[batch, width]``, not ``[batch, 1, width]``)."""
         if len(inputs) != self.strategy.num_inputs:
             raise ValueError(
                 f"Expected {self.strategy.num_inputs} inputs, got {len(inputs)}")
@@ -166,44 +220,60 @@ class DistributedEmbedding:
         for inp in inputs:
             if jnp.asarray(inp).dtype == jnp.int64:
                 comm_dtype = jnp.int64
-        out = []
+        out, was_1d = [], []
         for inp in inputs:
             inp = jnp.asarray(inp).astype(comm_dtype)
+            was_1d.append(inp.ndim == 1)
             out.append(inp[:, None] if inp.ndim == 1 else inp)
-        return out
+        return out, was_1d
 
-    def _lookup_local(self, flat_local: jax.Array, rank: int,
+    def _lookup_local(self, params: EmbedParams, rank: int,
                       inputs: Sequence[jax.Array],
                       flatten_2d: bool) -> List[jax.Array]:
-        """Per-rank local lookups (the hot loop, reference ``:291-294``)."""
+        """Per-rank local lookups (the hot loop, reference ``:291-294``).
+
+        Gathers run directly on the width slab with row-shifted ids — no table
+        materialization; ids out of the table's range clip inside the slab
+        (callers guarantee in-range ids, as does the reference)."""
         outs = []
         for inp, m in zip(inputs, self.strategy.local_map_list[rank]):
             cfg = self.strategy.local_configs_list[rank][m]
-            table = self.local_table(flat_local, rank, m)
-            combiner = cfg.get("combiner")
-            if combiner:
-                o = embedding_lookup(table, inp, combiner=combiner)
-            else:
-                o = embedding_lookup(table, inp)
+            k, roff, rows, w = self._table_rows(rank, m)
+            slab = params[k]
+            shifted = jnp.clip(inp, 0, rows - 1) + roff
+            o = embedding_lookup(slab, shifted, combiner=cfg.get("combiner"))
             outs.append(o.reshape(o.shape[0], -1) if flatten_2d else o)
         return outs
 
-    def __call__(self, flat_params: jax.Array, inputs) -> List[jax.Array]:
+    def __call__(self, params: EmbedParams, inputs) -> List[jax.Array]:
         """Forward pass.
 
-        * ``world_size == 1``: ``flat_params`` is the rank-0 slab ``[capacity]``
-          (or ``[1, capacity]``); plain local lookups, original output ranks
+        * ``world_size == 1``: plain local lookups, original output ranks
           preserved (reference ``call``, ``:493-500``).
         * distributed: must run inside ``shard_map`` with ``axis_name`` bound;
-          ``flat_params`` is this device's slab ``[capacity]`` (pass the global
-          ``[world, capacity]`` through ``in_specs=P(axis_name)`` and squeeze).
+          ``params`` are this device's slabs (pass the global dict through
+          ``in_specs=P(axis_name)``).
         """
-        inputs = self._normalize_inputs(inputs)
-        if flat_params.ndim == 2:
-            flat_params = flat_params.reshape(-1)
+        return self.forward_with_residuals(params, inputs)[0]
+
+    def forward_with_residuals(self, params: EmbedParams, inputs):
+        """Forward pass that also returns the routing residuals needed by
+        :meth:`sparse_apply_gradients` (the manual sparse backward).
+
+        Residuals carry the *model-parallel-side* ids (post-exchange), so the
+        backward never re-runs the id all-to-all — mirroring how the reference
+        backward reuses the forward op's inputs
+        (``embedding_lookup_ops.py:116-122``).
+        """
+        params = self.local_view(params)
+        inputs, was_1d = self._normalize_inputs(inputs)
 
         if self.world_size == 1:
-            return self._lookup_local(flat_params, 0, inputs, flatten_2d=False)
+            outs = self._lookup_local(params, 0, inputs, flatten_2d=False)
+            # reference parity: a 1-D no-combiner input yields [batch, width]
+            outs = [o[:, 0, :] if (sq and o.ndim == 3 and o.shape[1] == 1)
+                    else o for o, sq in zip(outs, was_1d)]
+            return outs, ("local", inputs)
 
         world = self.world_size
         b = inputs[0].shape[0]
@@ -239,29 +309,33 @@ class DistributedEmbedding:
             for r, ids in enumerate(self.strategy.input_ids_list)]
         s_max = max(max((sum(ws) for ws in out_widths_list), default=1), 1)
 
-        def branch(rank, flat_local, recv):
+        def branch(rank, params_, recv):
             ids = self.strategy.input_ids_list[rank]
             parsed, pos = [], 0
             for i in ids:
                 seg = lax.slice(recv, (0, pos), (world, pos + b * hots[i]))
                 parsed.append(seg.reshape(world * b, hots[i]))
                 pos += b * hots[i]
-            outs = self._lookup_local(flat_local, rank, parsed, flatten_2d=True)
+            outs = self._lookup_local(params_, rank, parsed, flatten_2d=True)
+            dt = next(iter(params_.values())).dtype
             if outs:
                 cat = jnp.concatenate(outs, axis=1)
             else:
-                cat = jnp.zeros((world * b, 0), flat_local.dtype)
+                # keep branch output types identical across ranks: match the
+                # param dtype and mark the constant device-varying
+                cat = _pvary(jnp.zeros((world * b, 0), dt), self.axis_name)
             pad = s_max - cat.shape[1]
             if pad:
                 cat = jnp.concatenate(
-                    [cat, jnp.zeros((world * b, pad), cat.dtype)], axis=1)
+                    [cat, _pvary(jnp.zeros((world * b, pad), cat.dtype),
+                                    self.axis_name)], axis=1)
             return cat
 
         my_rank = lax.axis_index(self.axis_name)
         mp_out = lax.switch(
             my_rank,
             [functools.partial(branch, r) for r in range(world)],
-            flat_params, ids_recv)  # [world*b, s_max]
+            params, ids_recv)  # [world*b, s_max]
 
         # --- mp -> dp output exchange --------------------------------------
         dp_recv = lax.all_to_all(
@@ -280,69 +354,219 @@ class DistributedEmbedding:
         result = [worker_order[i] for i in self.strategy.rev_global_input_ids]
         for start, end in self.strategy.sliced_out_ranges:
             result[start:end] = [jnp.concatenate(result[start:end], axis=-1)]
-        return result
+        return result, ("dist", ids_recv, hots, b, out_widths_list, s_max)
 
     def _input_config(self, rank: int, j: int):
         """Config of the table serving the j-th input routed to ``rank``."""
         m = self.strategy.local_map_list[rank][j]
         return self.strategy.local_configs_list[rank][m]
 
+    # ------------------------------------------------------ sparse backward
+
+    def _combiner_backward(self, grad: jax.Array, ids: jax.Array, combiner):
+        """Dense-input combiner backward: per-id gradient rows.
+
+        ``grad`` is ``[n, out_width]``, ``ids`` is ``[n, h]``. Returns
+        ``(flat_ids [n*h], vals [n*h, width])`` — the expansion step of the
+        reference backward (``cc/kernels/embedding_lookup_kernels.cu:493-494``:
+        per-id row ids + 1/len weights for mean).
+        """
+        n, h = ids.shape
+        if not combiner:
+            width = grad.shape[1] // h
+            vals = grad.reshape(n * h, width)
+        elif combiner == "mean":
+            vals = jnp.repeat(grad / h, h, axis=0)
+        else:  # sum
+            vals = jnp.repeat(grad, h, axis=0)
+        return ids.reshape(-1), vals
+
+    def _rank_sparse_update(self, rank: int, params: EmbedParams, opt_state,
+                            parsed_inputs, grads, optimizer, lr, scale):
+        """Apply sparse updates for one rank's tables.
+
+        Ids are shifted into slab-row coordinates and grouped by width, so each
+        width slab takes ONE optimizer scatter per step regardless of how many
+        tables share it. Out-of-table ids are routed to the padding sentinel
+        (slab row capacity) and dropped by the optimizer's scatters."""
+        per_width: Dict[str, List] = {}
+        for j, (inp, grad) in enumerate(zip(parsed_inputs, grads)):
+            m = self.strategy.local_map_list[rank][j]
+            cfg = self.strategy.local_configs_list[rank][m]
+            k, roff, rows, w = self._table_rows(rank, m)
+            ids, vals = self._combiner_backward(grad, inp, cfg.get("combiner"))
+            cap = self.rows_cap[w]
+            shifted = jnp.where((ids >= 0) & (ids < rows), ids + roff, cap)
+            per_width.setdefault(k, []).append((shifted, vals))
+        new_params = dict(params)
+        new_state = dict(opt_state) if isinstance(opt_state, dict) else opt_state
+        for k in sorted(per_width):
+            pairs = per_width[k]
+            ids = jnp.concatenate([p[0] for p in pairs])
+            vals = jnp.concatenate([p[1] for p in pairs]) * scale
+            slab = new_params[k]
+            st = new_state[k] if isinstance(new_state, dict) else new_state
+            slab, st = optimizer.apply_rows(slab, st, ids, vals, lr)
+            new_params[k] = slab
+            if isinstance(new_state, dict):
+                new_state[k] = st
+        return new_params, new_state
+
+    def sparse_apply_gradients(self, params: EmbedParams, opt_state, residuals,
+                               out_grads, optimizer, lr, scale=None):
+        """Manual sparse backward + in-place optimizer update.
+
+        Replaces autodiff w.r.t. the parameter slabs: ``out_grads`` are the
+        cotangents of this layer's *outputs* (obtained by differentiating the
+        dense model w.r.t. the embedding activations), routed back through the
+        reverse output all-to-all and applied as per-row scatter updates —
+        never materializing dense table gradients. This is the IndexedSlices
+        pipeline of the reference (``dist_model_parallel.py:526-567`` + the
+        grad kernel) in SPMD form.
+
+        Args:
+          params: this device's slabs (any leading world axis squeezed).
+          opt_state: optimizer slab state from ``optimizer.init``.
+          residuals: second output of :meth:`forward_with_residuals`.
+          out_grads: list of cotangents matching the forward outputs.
+          optimizer: :class:`~.optimizers.SparseSGD` /
+            :class:`~.optimizers.SparseAdagrad`.
+          lr: learning rate (scalar or traced).
+          scale: gradient pre-scale; defaults to ``1/world_size``, matching the
+            reference's mp-gradient scaling (``dist_model_parallel.py:542-546``)
+            under a pmean-averaged data-parallel loss.
+
+        Returns:
+          ``(new_params, new_opt_state)``.
+        """
+        params = self.local_view(params)
+        if isinstance(opt_state, dict):
+            opt_state = self.local_view(opt_state)
+        if scale is None:
+            scale = 1.0 / self.world_size
+
+        if residuals[0] == "local":
+            _, inputs = residuals
+            grads = [g.reshape(g.shape[0], -1) for g in out_grads]
+            return self._rank_sparse_update(
+                0, params, opt_state, inputs, grads, optimizer, lr, scale)
+
+        _, ids_recv, hots, b, out_widths_list, s_max = residuals
+        world = self.world_size
+
+        # Invert the column-slice collapse then the input-order reorder,
+        # rebuilding worker order. In fully-expanded coordinates, output entry
+        # e has width worker_widths[rev[e]]; input i owns the next
+        # slices-per-table[table(i)] expanded entries.
+        worker_widths = [w for ws in out_widths_list for w in ws]
+        rev = self.strategy.rev_global_input_ids
+        expanded: List[Optional[jax.Array]] = []
+        e = 0
+        for i, g in enumerate(out_grads):
+            k = self._slices_per_table[self.strategy.input_table_map[i]]
+            if k == 1:
+                expanded.append(g)
+            else:
+                pos = 0
+                for s in range(k):
+                    w = worker_widths[rev[e + s]]
+                    expanded.append(lax.slice(g, (0, pos), (b, pos + w)))
+                    pos += w
+            e += k
+        worker_grads: List[Optional[jax.Array]] = [None] * len(rev)
+        for idx, g in enumerate(expanded):
+            worker_grads[rev[idx]] = g
+
+        # Pack per source rank, pad to s_max, reverse the output all-to-all.
+        out_dtype = (out_grads[0].dtype if out_grads
+                     else next(iter(params.values())).dtype)
+        rows, k2 = [], 0
+        for ws in out_widths_list:
+            cat = (jnp.concatenate(worker_grads[k2:k2 + len(ws)], axis=1)
+                   if ws else _pvary(jnp.zeros((b, 0), out_dtype),
+                                        self.axis_name))
+            k2 += len(ws)
+            pad = s_max - cat.shape[1]
+            if pad:
+                cat = jnp.concatenate(
+                    [cat, _pvary(jnp.zeros((b, pad), cat.dtype),
+                                    self.axis_name)], axis=1)
+            rows.append(cat)
+        packed = jnp.stack(rows)  # [world, b, s_max]
+        mp_grad = lax.all_to_all(packed, self.axis_name, 0, 0, tiled=True)
+        mp_grad = mp_grad.reshape(world * b, s_max)
+
+        # Rank-specialized update (same switch pattern as the forward).
+        def branch(rank, params_, state_, recv, grad):
+            parsed, pos = [], 0
+            for i in self.strategy.input_ids_list[rank]:
+                seg = lax.slice(recv, (0, pos), (world, pos + b * hots[i]))
+                parsed.append(seg.reshape(world * b, hots[i]))
+                pos += b * hots[i]
+            gslices, gpos = [], 0
+            for w in out_widths_list[rank]:
+                gslices.append(lax.slice(grad, (0, gpos),
+                                         (world * b, gpos + w)))
+                gpos += w
+            return self._rank_sparse_update(
+                rank, params_, state_, parsed, gslices, optimizer, lr, scale)
+
+        my_rank = lax.axis_index(self.axis_name)
+        return lax.switch(
+            my_rank,
+            [functools.partial(branch, r) for r in range(world)],
+            params, opt_state, ids_recv, mp_grad)
+
     # ------------------------------------------------------------- checkpoint
 
-    def get_weights(self, flat_params) -> List[np.ndarray]:
+    def get_weights(self, params: EmbedParams) -> List[np.ndarray]:
         """Reassemble the full (unsliced) global tables on host.
 
         Equivalent of the reference's chunked-allgather ``get_weights``
         (``dist_model_parallel.py:411-485``); on a single host the sharded
-        buffer is addressable, so this is per-rank parse + slice concat.
+        slabs are addressable, so this is per-rank parse + slice concat.
         """
-        flat_params = np.asarray(jax.device_get(flat_params))
-        if flat_params.ndim == 1:
-            flat_params = flat_params[None]
+        host = {k: np.asarray(jax.device_get(v)) for k, v in params.items()}
+        host = {k: (v[None] if v.ndim == 2 else v) for k, v in host.items()}
         per_table: dict = {}
         for r, cfgs in enumerate(self.strategy.local_configs_list):
-            pos = 0
             for m, cfg in enumerate(cfgs):
-                rows, width = int(cfg["input_dim"]), int(cfg["output_dim"])
+                k, roff, rows, w = self._table_rows(r, m)
                 tid = self.strategy.table_ids_list[r][m]
-                chunk = flat_params[r, pos:pos + rows * width].reshape(rows, width)
-                per_table.setdefault(tid, []).append(chunk)
-                pos += rows * width
-        result = []
-        for tid in range(len(self.strategy.global_configs)):
-            result.append(np.concatenate(per_table[tid], axis=1)
-                          if len(per_table[tid]) > 1 else per_table[tid][0])
-        return result
+                per_table.setdefault(tid, []).append(
+                    host[k][r, roff:roff + rows, :])
+        return [np.concatenate(per_table[tid], axis=1)
+                if len(per_table[tid]) > 1 else per_table[tid][0]
+                for tid in range(len(self.strategy.global_configs))]
 
     def set_weights(self, weights: Sequence[Any], mesh=None,
-                    dtype=jnp.float32) -> jax.Array:
-        """Build the sharded ``[world, capacity]`` buffer from full global
-        tables (numpy arrays or ``np.load``-able paths, mmap'd like the
-        reference, ``dist_model_parallel.py:337-339``)."""
+                    dtype=jnp.float32) -> EmbedParams:
+        """Build the sharded slab dict from full global tables (numpy arrays
+        or ``np.load``-able paths, mmap'd like the reference,
+        ``dist_model_parallel.py:337-339``)."""
         loaded = [np.load(w, mmap_mode="r") if isinstance(w, str) else w
                   for w in weights]
         if len(loaded) != len(self.strategy.global_configs):
             raise ValueError("set_weights needs one array per global table")
         # Column offset of each slice, consumed in rank order per table.
         col_pos = {tid: 0 for tid in range(len(loaded))}
-        out = np.zeros((self.world_size, self.capacity), np.float32)
+        out = {w: np.zeros((self.world_size, self.rows_cap[w], w), np.float32)
+               for w in self.widths}
         for r, cfgs in enumerate(self.strategy.local_configs_list):
-            pos = 0
             for m, cfg in enumerate(cfgs):
-                rows, width = int(cfg["input_dim"]), int(cfg["output_dim"])
+                k, roff, rows, w = self._table_rows(r, m)
                 tid = self.strategy.table_ids_list[r][m]
                 src = loaded[tid]
                 if src.shape[0] != rows:
                     raise ValueError(
                         f"Table {tid}: expected {rows} rows, got {src.shape[0]}")
                 start = col_pos[tid]
-                out[r, pos:pos + rows * width] = np.ascontiguousarray(
-                    src[:, start:start + width]).reshape(-1)
-                col_pos[tid] = start + width
-                pos += rows * width
-        arr = jnp.asarray(out, dtype)
+                out[w][r, roff:roff + rows, :] = src[:, start:start + w]
+                col_pos[tid] = start + w
+        result = {_wkey(w): jnp.asarray(v, dtype) for w, v in out.items()}
         if mesh is not None:
             sharding = jax.sharding.NamedSharding(
                 mesh, jax.sharding.PartitionSpec(self.axis_name))
-            arr = jax.device_put(arr, sharding)
-        return arr
+            result = {k: jax.device_put(v, sharding)
+                      for k, v in result.items()}
+        return result

@@ -13,7 +13,7 @@ families —
 Instead of tagging variables with ``VariableSynchronization.NONE``
 (``:258``), partitioning is expressed as a pytree mask: JAX params are plain
 arrays, so callers say which subtree is model-parallel (for
-:class:`.DistributedEmbedding` that is its flat parameter buffer).
+:class:`.DistributedEmbedding` that is its width-grouped slab dict).
 """
 
 from __future__ import annotations
@@ -43,16 +43,41 @@ def split_mp_dp(tree: Any, mp_mask: Any):
     return mp, dp
 
 
+def resolve_dp_gradient(g: jax.Array, axis_name: str) -> jax.Array:
+    """Average a data-parallel gradient across the mesh axis, accounting for
+    shard_map's varying-manual-axes (VMA) autodiff semantics.
+
+    Inside ``shard_map`` with replication checking, differentiating a
+    device-varying loss w.r.t. an *unvarying* (replicated, ``P()``-spec)
+    parameter already inserts the cross-device ``psum`` — the transpose of the
+    implicit broadcast — so the raw gradient equals the sum of per-device
+    contributions and a further ``pmean`` would be an identity. A gradient
+    that is still device-varying needs the explicit ``pmean``. Distinguish by
+    the gradient's vma type.
+
+    Requires shard_map's default replication checking (``check_vma=True``):
+    under ``check_vma=False`` every value reports an empty vma set, the
+    auto-psum does not happen, and this helper cannot tell the two cases
+    apart. When no vma typing is present at all, fall back to ``pmean``
+    (the pre-VMA semantics).
+    """
+    vma = getattr(jax.typeof(g), "vma", None)
+    if vma is None or axis_name in vma:
+        return lax.pmean(g, axis_name)
+    return g / lax.axis_size(axis_name)
+
+
 def hybrid_gradients(grads: Any, mp_mask: Any, axis_name: str) -> Any:
     """Resolve a raw gradient pytree into hybrid-parallel gradients.
 
     Must run inside ``shard_map``/``pjit`` with ``axis_name`` bound. dp leaves
-    are ``pmean``-ed over the axis; mp leaves are divided by the axis size.
+    are averaged over the axis (see :func:`resolve_dp_gradient`); mp leaves
+    are divided by the axis size.
     """
     world = lax.axis_size(axis_name)
     return _map_by_mask(
         lambda g: None if g is None else g / world,
-        lambda g: None if g is None else lax.pmean(g, axis_name),
+        lambda g: None if g is None else resolve_dp_gradient(g, axis_name),
         mp_mask, grads)
 
 

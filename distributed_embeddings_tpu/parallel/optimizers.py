@@ -1,0 +1,78 @@
+"""Sparse embedding optimizers over width-grouped 2-D table slabs.
+
+The reference applies ``tf.IndexedSlices`` gradients through Keras optimizers'
+sparse paths (``optimizer.apply_gradients`` after
+``dist_model_parallel.py:526-567``), touching only the looked-up rows. optax
+has no IndexedSlices, so dense-gradient training would read+write every table
+row each step — the difference between HBM-bound O(touched rows) and
+O(all rows). These optimizers reproduce the sparse behavior on the
+``[rows_cap, width]`` slabs used by
+:class:`~distributed_embeddings_tpu.parallel.DistributedEmbedding`.
+
+Performance notes (TPU): updates are native 2-D row scatters
+(``slab.at[row_ids].add(values)``) — the one scatter form XLA's TPU backend
+lowers efficiently. Flat 1-D windowed/element scatters lower to a serialized
+path measured ~30x slower end-to-end; hence the width-grouped 2-D layout.
+Invalid/padded ids equal the slab row capacity, land out of bounds, and are
+dropped (``mode='drop'``) — the static-shape analogue of the reference's
+dynamic ``num_unique``.
+
+:class:`SparseAdagrad` dedups duplicate ids first (sort + segment-sum — the
+CUB sort/unique of the reference backward, ``.cu:499-515``) because its update
+is nonlinear in the gradient; :class:`SparseSGD` scatter-adds duplicates
+directly. Numerics match ``optax.sgd`` / ``optax.adagrad`` (initial
+accumulator 0.1, eps 1e-7) so the dense data-parallel side can use optax and
+both families see the same optimizer semantics.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.sparse_grad import dedup_sparse_grad
+
+
+class SparseSGD:
+    """Plain SGD on slab rows; duplicate ids accumulate via scatter-add."""
+
+    def init(self, params):
+        return jax.tree.map(lambda _: (), params)
+
+    def apply_rows(self, slab: jax.Array, state, ids: jax.Array,
+                   vals: jax.Array, lr):
+        """``slab[ids] -= lr * vals``; ids >= slab rows are dropped."""
+        slab = slab.at[ids].add(-lr * vals.astype(slab.dtype), mode="drop")
+        return slab, state
+
+
+class SparseAdagrad:
+    """Adagrad with slab-shaped accumulators; optax.adagrad numerics
+    (accumulator init 0.1, ``param -= lr * g * rsqrt(acc_new + eps)``)."""
+
+    def __init__(self, initial_accumulator_value: float = 0.1,
+                 eps: float = 1e-7):
+        self.initial_accumulator_value = initial_accumulator_value
+        self.eps = eps
+
+    def init(self, params):
+        return jax.tree.map(
+            lambda p: jnp.full_like(p, self.initial_accumulator_value), params)
+
+    def apply_rows(self, slab: jax.Array, accum: jax.Array, ids: jax.Array,
+                   vals: jax.Array, lr):
+        vals = vals.astype(slab.dtype)
+        # nonlinear in g: must sum duplicate rows before the rsqrt
+        uids, uvals = dedup_sparse_grad(ids, vals, pad_id=slab.shape[0])
+        acc_rows = jnp.take(accum, uids, axis=0, mode="clip")
+        new_acc = acc_rows + uvals * uvals
+        accum = accum.at[uids].set(new_acc, mode="drop",
+                                   indices_are_sorted=True,
+                                   unique_indices=True)
+        # optax scale_by_rss semantics: g * rsqrt(acc_new + eps)
+        update = lr * uvals * lax.rsqrt(new_acc + self.eps)
+        slab = slab.at[uids].add(-update, mode="drop",
+                                 indices_are_sorted=True,
+                                 unique_indices=True)
+        return slab, accum

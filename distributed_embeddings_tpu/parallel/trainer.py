@@ -1,0 +1,128 @@
+"""Hybrid-parallel training step builder.
+
+Composes the pieces the reference wires manually in its examples
+(``examples/dlrm/main.py:201-210``: tape → ``DistributedGradientTape`` →
+``optimizer.apply_gradients``) into one jitted SPMD step:
+
+* dense (data-parallel) parameters: autodiff + ``lax.pmean`` + any optax
+  transform;
+* embedding (model-parallel) slabs: **no autodiff through the tables** — the
+  dense model is differentiated w.r.t. the embedding *activations*, and those
+  cotangents feed :meth:`DistributedEmbedding.sparse_apply_gradients`, which
+  routes them through the reverse all-to-all and applies per-row scatter
+  updates (the IndexedSlices path). The slab and its optimizer state are
+  donated, so updates are in-place on device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .dist_embedding import DistributedEmbedding
+from .grads import resolve_dp_gradient
+
+
+class HybridTrainState(NamedTuple):
+    """All mutable training state. ``emb_params``/``emb_opt_state`` are the
+    model-parallel slab dicts ``{width: [world, rows_cap, width]}``; the rest
+    is replicated."""
+    emb_params: Any
+    emb_opt_state: Any
+    dense_params: Any
+    dense_opt_state: Any
+    step: jax.Array
+
+
+def make_hybrid_train_step(de: DistributedEmbedding,
+                           loss_fn: Callable,
+                           dense_tx: optax.GradientTransformation,
+                           emb_optimizer,
+                           mesh=None,
+                           lr_schedule=1.0):
+    """Build ``step(state, cat_inputs, batch) -> (loss, state)``.
+
+    Args:
+      de: the distributed embedding layer.
+      loss_fn: ``loss_fn(dense_params, emb_outputs, batch) -> scalar`` local
+        mean loss over the per-device batch shard.
+      dense_tx: optax transform for the dense (data-parallel) parameters.
+      emb_optimizer: sparse slab optimizer (:class:`~.optimizers.SparseSGD` /
+        :class:`~.optimizers.SparseAdagrad`).
+      mesh: required when ``de.world_size > 1``.
+      lr_schedule: embedding-optimizer learning rate — a constant or a
+        ``step -> lr`` callable (the dense side can use optax schedules
+        natively).
+
+    The returned step takes data-parallel shards: each categorical input
+    ``[local_batch, hotness]`` and ``batch`` any pytree of per-device arrays
+    the loss consumes (already sharded by the caller).
+    """
+    world = de.world_size
+
+    def local_step(state: HybridTrainState, cat_inputs, batch):
+        # slabs are {width: [world, rows, w]} globally -> [rows, w] per device
+        emb_local = de.local_view(state.emb_params)
+        emb_opt_local = de.local_view(state.emb_opt_state)
+        outs, res = de.forward_with_residuals(emb_local, cat_inputs)
+
+        loss, (dense_grads, out_grads) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1))(state.dense_params, outs, batch)
+        if world > 1:
+            loss = lax.pmean(loss, de.axis_name)
+            dense_grads = jax.tree.map(
+                lambda g: resolve_dp_gradient(g, de.axis_name), dense_grads)
+
+        lr = lr_schedule(state.step) if callable(lr_schedule) else lr_schedule
+        emb_local, emb_opt_local = de.sparse_apply_gradients(
+            emb_local, emb_opt_local, res, out_grads, emb_optimizer, lr)
+
+        updates, dense_opt_state = dense_tx.update(
+            dense_grads, state.dense_opt_state, state.dense_params)
+        dense_params = optax.apply_updates(state.dense_params, updates)
+
+        new_state = HybridTrainState(
+            emb_params=de.stacked_view(emb_local),
+            emb_opt_state=de.stacked_view(emb_opt_local),
+            dense_params=dense_params, dense_opt_state=dense_opt_state,
+            step=state.step + 1)
+        return loss, new_state
+
+    if world == 1:
+        return jax.jit(local_step, donate_argnums=(0,))
+
+    if mesh is None:
+        raise ValueError("mesh is required for world_size > 1")
+    ax = de.axis_name
+    state_specs = HybridTrainState(
+        emb_params=P(ax), emb_opt_state=P(ax),
+        dense_params=P(), dense_opt_state=P(), step=P())
+
+    sm = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(state_specs, P(ax), P(ax)),
+        out_specs=(P(), state_specs))
+    return jax.jit(sm, donate_argnums=(0,))
+
+
+def init_hybrid_state(de: DistributedEmbedding, emb_optimizer,
+                      dense_params, dense_tx, key, mesh=None,
+                      dtype=jnp.float32) -> HybridTrainState:
+    """Initialize all state, with slabs laid out on the mesh."""
+    emb_params = de.init(key, dtype=dtype, mesh=mesh)
+    emb_opt_state = emb_optimizer.init(emb_params)
+    if mesh is not None:
+        sharding = NamedSharding(mesh, P(de.axis_name))
+        emb_opt_state = jax.tree.map(
+            lambda a: jax.device_put(a, sharding), emb_opt_state)
+    return HybridTrainState(
+        emb_params=emb_params,
+        emb_opt_state=emb_opt_state,
+        dense_params=dense_params,
+        dense_opt_state=dense_tx.init(dense_params),
+        step=jnp.zeros((), jnp.int32))

@@ -1,0 +1,4 @@
+"""Data pipelines and metrics for the example models."""
+
+from .data import DummyDataset, RawBinaryDataset, power_law_ids
+from .metrics import binary_auc

@@ -1,0 +1,185 @@
+"""Datasets: synthetic benchmark feeds and the Criteo raw-binary reader.
+
+TPU equivalents of the reference's data layer (``examples/dlrm/utils.py``):
+
+* :class:`DummyDataset` — constant synthetic batches for benchmarking
+  (reference ``utils.py:126-154``).
+* :class:`RawBinaryDataset` — the split Criteo binary format (``label.bin``,
+  ``numerical.bin`` float16, per-feature ``cat_<i>.bin`` in the smallest int
+  type that fits the vocab; reference ``utils.py:157-307``). Reading uses
+  ``np.memmap`` + a background prefetch thread instead of raw ``os.pread``;
+  a C-accelerated path can plug in transparently (see ``cc/``).
+* :func:`power_law_ids` — the power-law id generator used by the synthetic
+  model benchmarks (``examples/benchmarks/synthetic_models/synthetic_models.py:31-113``).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import queue
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+def get_categorical_feature_type(size: int):
+    """Smallest signed int dtype that can hold ids below ``size``
+    (reference ``utils.py:116-123``)."""
+    for t in (np.int8, np.int16, np.int32):
+        if size < np.iinfo(t).max:
+            return t
+    raise RuntimeError(f"Categorical feature of size {size} is too big")
+
+
+def power_law_ids(rng: np.random.Generator, vocab: int, shape,
+                  alpha: float = 1.05) -> np.ndarray:
+    """Power-law distributed ids in ``[0, vocab)``: hot ids dominate, matching
+    real recommender id distributions (reference ``power_law`` /
+    ``gen_power_law_data``)."""
+    u = rng.random(size=shape)
+    # inverse-CDF of p(x) ~ x^(-alpha) on [1, vocab+1)
+    exp = 1.0 - alpha
+    ids = ((vocab + 1) ** exp * u + (1 - u)) ** (1.0 / exp) - 1.0
+    return np.clip(ids.astype(np.int64), 0, vocab - 1)
+
+
+class DummyDataset:
+    """Fixed synthetic batches (all-zero ids, like the reference's
+    ``DummyDataset`` — measuring the compute path, not input randomness)."""
+
+    def __init__(self, batch_size: int, num_numerical_features: int,
+                 table_sizes: Sequence[int], num_batches: int,
+                 hotness: int = 1, num_workers: int = 1):
+        local_bs = batch_size // num_workers
+        self.numerical = np.zeros((local_bs, num_numerical_features),
+                                  np.float32)
+        self.categorical = [np.zeros((local_bs, hotness), np.int32)
+                            for _ in table_sizes]
+        self.labels = np.ones((local_bs, 1), np.float32)
+        self.num_batches = num_batches
+
+    def __len__(self):
+        return self.num_batches
+
+    def __getitem__(self, idx):
+        if idx >= self.num_batches:
+            raise IndexError
+        return self.numerical, self.categorical, self.labels
+
+    def __iter__(self):
+        for i in range(self.num_batches):
+            yield self[i]
+
+
+class RawBinaryDataset:
+    """Split-binary Criteo reader.
+
+    Layout (identical to the reference's, ``examples/dlrm/utils.py:157-237``):
+    ``<root>/<train|test>/label.bin`` (bool), ``numerical.bin`` (float16,
+    ``[N, num_numerical]`` row-major), ``cat_<i>.bin`` (per-feature smallest
+    int type). Yields ``(numerical [B, F] float32, categorical list of
+    [B] int32, labels [B, 1] float32)``.
+
+    Args:
+      data_path: dataset root.
+      batch_size: global batch size.
+      numerical_features: how many numerical columns to read (0 = none).
+      categorical_features: feature ids this worker needs (model-parallel
+        input reads only the local tables' files, reference ``main.py:166-176``).
+      categorical_feature_sizes: vocab sizes for ALL features (determines the
+        stored dtype of each file).
+      offset/lbs: slice ``[offset, offset+lbs)`` of each batch for
+        data-parallel shards (labels/numerical always sliced; categorical
+        sliced only when ``dp_input``).
+      drop_last_batch: drop the trailing partial batch.
+      valid: read the ``test`` split.
+      prefetch_depth: background-thread read-ahead.
+    """
+
+    def __init__(self, data_path: str, batch_size: int = 1,
+                 numerical_features: int = 0,
+                 categorical_features: Optional[Sequence[int]] = None,
+                 categorical_feature_sizes: Optional[Sequence[int]] = None,
+                 prefetch_depth: int = 10, drop_last_batch: bool = False,
+                 valid: bool = False, offset: int = -1, lbs: int = -1,
+                 dp_input: bool = False):
+        split_dir = os.path.join(data_path, "test" if valid else "train")
+        self._batch_size = batch_size
+        self._num_numerical = numerical_features
+        self.offset, self.lbs, self.valid = offset, lbs, valid
+        self.dp_input = dp_input
+
+        self._labels = np.memmap(os.path.join(split_dir, "label.bin"),
+                                 dtype=np.bool_, mode="r")
+        n = len(self._labels)
+        self._num_entries = (n // batch_size if drop_last_batch
+                             else math.ceil(n / batch_size))
+
+        if numerical_features > 0:
+            num = np.memmap(os.path.join(split_dir, "numerical.bin"),
+                            dtype=np.float16, mode="r")
+            self._numerical = num.reshape(-1, numerical_features)
+            if len(self._numerical) != n:
+                raise ValueError("numerical.bin row count mismatch")
+        else:
+            self._numerical = None
+
+        self._cat_maps: List[np.memmap] = []
+        self._cat_ids = list(categorical_features or [])
+        sizes = list(categorical_feature_sizes or [])
+        for cid in self._cat_ids:
+            dt = get_categorical_feature_type(sizes[cid])
+            m = np.memmap(os.path.join(split_dir, f"cat_{cid}.bin"),
+                          dtype=dt, mode="r")
+            if len(m) != n:
+                raise ValueError(f"cat_{cid}.bin row count mismatch")
+            self._cat_maps.append(m)
+
+        self._prefetch_depth = min(prefetch_depth, self._num_entries)
+        self._queue: "queue.Queue" = queue.Queue()
+        self._thread = None
+
+    def __len__(self):
+        return self._num_entries
+
+    def _read(self, idx: int):
+        lo, hi = idx * self._batch_size, (idx + 1) * self._batch_size
+        labels = np.asarray(self._labels[lo:hi], np.float32)[:, None]
+        numerical = (np.asarray(self._numerical[lo:hi], np.float32)
+                     if self._numerical is not None else
+                     np.zeros((labels.shape[0], 0), np.float32))
+        cats = [np.asarray(m[lo:hi], np.int32) for m in self._cat_maps]
+        if self.offset >= 0:
+            sl = slice(self.offset, self.offset + self.lbs)
+            if not self.valid:
+                labels = labels[sl]
+            numerical = numerical[sl]
+            if self.dp_input:
+                cats = [c[sl] for c in cats]
+        return numerical, cats, labels
+
+    def __getitem__(self, idx: int):
+        if idx >= self._num_entries:
+            raise IndexError
+        return self._read(idx)
+
+    def __iter__(self):
+        if self._prefetch_depth <= 1:
+            for i in range(self._num_entries):
+                yield self._read(i)
+            return
+
+        def producer():
+            for i in range(self._num_entries):
+                self._queue.put(self._read(i))
+            self._queue.put(None)
+
+        self._thread = threading.Thread(target=producer, daemon=True)
+        self._thread.start()
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            yield item

@@ -88,8 +88,8 @@ def reference_forward(tables, configs, input_table_map, inputs):
 
 
 def dist_forward_fn(de, mesh, n_inputs):
-    def fwd(flat_local, *inps):
-        return tuple(de(flat_local.reshape(-1), list(inps)))
+    def fwd(params, *inps):
+        return tuple(de(params, list(inps)))
 
     return jax.jit(jax.shard_map(
         fwd, mesh=mesh,
@@ -169,14 +169,14 @@ def test_sgd_step_matches_reference(mesh, strategy):
     lr = 0.5
 
     # --- distributed step -------------------------------------------------
-    def local_loss(flat_local, *inps):
-        outs = de(flat_local.reshape(-1), list(inps))
+    def local_loss(params, *inps):
+        outs = de(params, list(inps))
         return sum(jnp.mean(o ** 2) for o in outs)
 
-    def step(flat_local, *inps):
+    def step(params, *inps):
         loss, grads = hybrid_value_and_grad(
-            local_loss, mp_mask=True, axis_name="data")(flat_local, *inps)
-        return flat_local - lr * grads
+            local_loss, mp_mask=True, axis_name="data")(params, *inps)
+        return jax.tree.map(lambda p, g: p - lr * g, params, grads)
 
     new_flat = jax.jit(jax.shard_map(
         step, mesh=mesh,
@@ -214,6 +214,27 @@ def test_column_slice_dup_worker(mesh):
               for _ in range(8)]
     outs = dist_forward_fn(de, mesh, 8)(flat, *inputs)
     expect = reference_forward(tables, configs, list(range(8)), inputs)
+    for o, e in zip(outs, expect):
+        np.testing.assert_allclose(np.asarray(o), np.asarray(e),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_rank_with_no_inputs(mesh):
+    """A table with no mapped input leaves its rank with nothing to route:
+    branch outputs must still type-match across ranks."""
+    rng = np.random.default_rng(23)
+    configs = [{"input_dim": 16, "output_dim": 4, "combiner": None}
+               for _ in range(9)]
+    # inputs only reference tables 0..7; table 8's owner routes no inputs
+    input_table_map = list(range(8))
+    de = DistributedEmbedding(configs, world_size=WORLD,
+                              input_table_map=input_table_map)
+    flat = de.init(jax.random.key(5), mesh=mesh)
+    tables = de.get_weights(flat)
+    inputs = [jnp.asarray(rng.integers(0, 16, size=(WORLD * 2, 1)), jnp.int32)
+              for _ in range(8)]
+    outs = dist_forward_fn(de, mesh, 8)(flat, *inputs)
+    expect = reference_forward(tables, configs, input_table_map, inputs)
     for o, e in zip(outs, expect):
         np.testing.assert_allclose(np.asarray(o), np.asarray(e),
                                    rtol=1e-5, atol=1e-6)

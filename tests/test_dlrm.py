@@ -1,0 +1,128 @@
+"""DLRM model tests (reference: ``examples/dlrm/``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_embeddings_tpu.models import (
+    DLRM,
+    DLRMConfig,
+    dot_interact,
+)
+from distributed_embeddings_tpu.models.dlrm import DLRMDense, bce_with_logits
+from distributed_embeddings_tpu.models.schedules import warmup_poly_decay_schedule
+from distributed_embeddings_tpu.parallel import (
+    DistributedEmbedding,
+    SparseSGD,
+    HybridTrainState,
+    make_hybrid_train_step,
+)
+from distributed_embeddings_tpu.utils import binary_auc
+
+
+def small_config(tables=6, dim=8):
+    return DLRMConfig(table_sizes=[50 + 7 * i for i in range(tables)],
+                      embedding_dim=dim,
+                      num_numerical_features=4,
+                      bottom_mlp_dims=[16, dim],
+                      top_mlp_dims=[32, 16, 1])
+
+
+def test_dot_interact_matches_numpy():
+    rng = np.random.default_rng(0)
+    B, F, D = 4, 5, 3
+    embs = [jnp.asarray(rng.normal(size=(B, D)), jnp.float32)
+            for _ in range(F - 1)]
+    bot = jnp.asarray(rng.normal(size=(B, D)), jnp.float32)
+    out = dot_interact(embs, bot)
+    feats = np.stack([np.asarray(bot)] + [np.asarray(e) for e in embs], 1)
+    gram = feats @ feats.transpose(0, 2, 1)
+    want = []
+    for b in range(B):
+        low = [gram[b, i, j] for i in range(F) for j in range(i)]
+        want.append(np.concatenate([low, feats[b, 0]]))
+    np.testing.assert_allclose(out, np.stack(want), rtol=1e-5)
+    assert out.shape == (B, F * (F - 1) // 2 + D)
+
+
+def test_dlrm_forward_and_local_train():
+    cfg = small_config()
+    model = DLRM(cfg)
+    params = model.init(jax.random.key(0))
+    rng = np.random.default_rng(1)
+    B = 32
+    num = jnp.asarray(rng.normal(size=(B, 4)), jnp.float32)
+    cats = [jnp.asarray(rng.integers(0, s, size=(B,)), jnp.int32)
+            for s in cfg.table_sizes]
+    logits = model.apply(params, num, cats)
+    assert logits.shape == (B, 1)
+    labels = jnp.asarray(rng.integers(0, 2, size=(B, 1)), jnp.float32)
+    loss = bce_with_logits(logits, labels)
+    assert np.isfinite(float(loss))
+
+
+@pytest.mark.parametrize("world", [1, 8])
+def test_dlrm_hybrid_training_loss_decreases(world):
+    cfg = small_config(tables=10)  # >= world ranks (reference constraint)
+    mesh = (Mesh(np.array(jax.devices()[:world]), ("data",))
+            if world > 1 else None)
+    de = DistributedEmbedding(cfg.embedding_configs(), world_size=world,
+                              strategy="memory_balanced")
+    dense = DLRMDense(cfg)
+    rng = np.random.default_rng(2)
+    B = 16 * world
+    num = jnp.asarray(rng.normal(size=(B, 4)), jnp.float32)
+    cats = [jnp.asarray(rng.integers(0, s, size=(B,)), jnp.int32)
+            for s in cfg.table_sizes]
+    labels = jnp.asarray(rng.integers(0, 2, size=(B, 1)), jnp.float32)
+
+    dense_params = dense.init(
+        jax.random.key(3), num[:2],
+        [jnp.zeros((2, cfg.embedding_dim), jnp.float32)
+         for _ in cfg.table_sizes])
+
+    def loss_fn(dp, emb_outs, batch):
+        n, y = batch
+        logits = dense.apply(dp, n, emb_outs)
+        return bce_with_logits(logits, y)
+
+    emb_opt = SparseSGD()
+    tx = optax.sgd(0.05)
+    flat = de.init(jax.random.key(4), mesh=mesh)
+    state = HybridTrainState(
+        emb_params=flat,
+        emb_opt_state=emb_opt.init(flat),
+        dense_params=dense_params,
+        dense_opt_state=tx.init(dense_params),
+        step=jnp.zeros((), jnp.int32))
+    step_fn = make_hybrid_train_step(de, loss_fn, tx, emb_opt, mesh=mesh,
+                                     lr_schedule=0.05)
+    losses = []
+    for _ in range(20):
+        loss, state = step_fn(state, cats, (num, labels))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
+
+
+def test_lr_schedule_phases():
+    sched = warmup_poly_decay_schedule(24.0, warmup_steps=10,
+                                       decay_start_step=20, decay_steps=10)
+    assert float(sched(0)) == pytest.approx(0.0, abs=1e-5)
+    assert float(sched(5)) == pytest.approx(12.0, rel=1e-5)
+    assert float(sched(15)) == pytest.approx(24.0)
+    assert float(sched(25)) == pytest.approx(24.0 * 0.25, rel=1e-5)
+    assert float(sched(40)) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_binary_auc():
+    labels = np.array([0, 0, 1, 1])
+    # perfect ranking
+    assert binary_auc(labels, np.array([0.1, 0.2, 0.8, 0.9])) == 1.0
+    # 1 of 4 (pos, neg) pairs correctly ordered
+    assert binary_auc(labels, np.array([0.9, 0.2, 0.8, 0.1])) == 0.25
+    # known partial
+    assert binary_auc(labels, np.array([0.3, 0.6, 0.5, 0.9])) == 0.75
