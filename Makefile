@@ -1,0 +1,22 @@
+# Build orchestration (reference: Makefile building the CUDA .so; here the
+# native piece is the C++ data-loader/id-generator shared library).
+
+.PHONY: all native test bench clean pkg
+
+all: native
+
+native:
+	$(MAKE) -C cc
+
+test:
+	python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+pkg:
+	python setup.py bdist_wheel
+
+clean:
+	$(MAKE) -C cc clean
+	rm -rf build dist *.egg-info

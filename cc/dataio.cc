@@ -1,0 +1,226 @@
+// Native data runtime for distributed_embeddings_tpu.
+//
+// TPU-native equivalent of the reference's native layer: where the reference
+// spends its C++/CUDA on lookup kernels (distributed_embeddings/cc/), the TPU
+// compute path is XLA/Pallas — the host-native piece that still matters is
+// feeding the chips. This library provides the input-pipeline hot loops:
+//
+//  * power-law id generation (reference python generator:
+//    examples/benchmarks/synthetic_models/synthetic_models.py:31-45)
+//  * COO row-ids -> CSR row_splits (reference RowToSplit CUDA kernel:
+//    cc/kernels/embedding_lookup_kernels.cu:331-350), host-side for pipelines
+//  * Criteo split-binary batch reader with dtype widening
+//    (reference RawBinaryDataset: examples/dlrm/utils.py:157-307): label
+//    bool->f32, numerical f16->f32, categorical int8/16/32 -> int32
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 dependency);
+// distributed_embeddings_tpu/utils/native.py holds the python bindings and a
+// pure-numpy fallback.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cmath>
+#include <fcntl.h>
+#include <string>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- random ids
+
+// splitmix64: tiny, fast, good enough for synthetic benchmark ids.
+static inline uint64_t splitmix64(uint64_t* s) {
+  uint64_t z = (*s += 0x9E3779B97f4A7C15ULL);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+// Power-law distributed ids in [0, vocab): inverse-CDF of p(x) ~ x^-alpha on
+// [1, vocab+1), minus 1 — matching the reference's power_law().
+void detpu_power_law_ids(uint64_t seed, double alpha, int64_t vocab,
+                         int64_t n, int32_t* out) {
+  uint64_t s = seed ? seed : 0x853c49e6748fea9bULL;
+  const double gamma = 1.0 - alpha;
+  const double k_min = 1.0, k_max = (double)vocab + 1.0;
+  const double pk_min = pow(k_min, gamma), pk_max = pow(k_max, gamma);
+  const double inv_gamma = 1.0 / gamma;
+  for (int64_t i = 0; i < n; ++i) {
+    double r = (double)(splitmix64(&s) >> 11) * (1.0 / 9007199254740992.0);
+    double y = pow(r * (pk_max - pk_min) + pk_min, inv_gamma) - 1.0;
+    int64_t id = (int64_t)y;
+    if (id < 0) id = 0;
+    if (id >= vocab) id = vocab - 1;
+    out[i] = (int32_t)id;
+  }
+}
+
+// Uniform ids in [0, vocab).
+void detpu_uniform_ids(uint64_t seed, int64_t vocab, int64_t n, int32_t* out) {
+  uint64_t s = seed ? seed : 0x9e3779b97f4a7c15ULL;
+  for (int64_t i = 0; i < n; ++i) {
+    out[i] = (int32_t)(splitmix64(&s) % (uint64_t)vocab);
+  }
+}
+
+// ------------------------------------------------------------- row_to_split
+
+// Sorted COO row ids [nnz] -> CSR row_splits [dim0+1] (binary search per
+// split, like the reference kernel's per-thread search).
+void detpu_row_to_split(const int64_t* rows, int64_t nnz, int64_t dim0,
+                        int32_t* splits) {
+  for (int64_t r = 0; r <= dim0; ++r) {
+    // lower_bound of r
+    int64_t lo = 0, hi = nnz;
+    while (lo < hi) {
+      int64_t mid = (lo + hi) / 2;
+      if (rows[mid] < r) lo = mid + 1; else hi = mid;
+    }
+    splits[r] = (int32_t)lo;
+  }
+}
+
+// ------------------------------------------------------------ criteo reader
+
+struct CriteoFile {
+  int fd;
+  int elem_size;  // bytes per element as stored
+};
+
+struct CriteoReader {
+  std::vector<CriteoFile> cats;
+  int label_fd = -1;
+  int numerical_fd = -1;
+  int num_numerical = 0;
+  int64_t num_samples = 0;
+};
+
+static int cat_elem_size(int64_t vocab) {
+  if (vocab < 127) return 1;
+  if (vocab < 32767) return 2;
+  return 4;
+}
+
+// Open <dir>/{label.bin, numerical.bin, cat_<i>.bin}. cat_ids selects which
+// categorical files this worker reads (model-parallel input reads only local
+// tables' files, reference main.py:166-176). Returns NULL on failure.
+void* detpu_criteo_open(const char* dir, const int32_t* cat_ids, int num_cats,
+                        const int64_t* all_sizes, int num_numerical) {
+  CriteoReader* r = new CriteoReader();
+  std::string base(dir);
+  std::string lp = base + "/label.bin";
+  r->label_fd = open(lp.c_str(), O_RDONLY);
+  if (r->label_fd < 0) { delete r; return nullptr; }
+  struct stat st;
+  fstat(r->label_fd, &st);
+  r->num_samples = st.st_size;  // bool = 1 byte/sample
+  r->num_numerical = num_numerical;
+  if (num_numerical > 0) {
+    std::string np_ = base + "/numerical.bin";
+    r->numerical_fd = open(np_.c_str(), O_RDONLY);
+    if (r->numerical_fd < 0) { delete r; return nullptr; }
+  }
+  for (int i = 0; i < num_cats; ++i) {
+    int cid = cat_ids[i];
+    std::string cp = base + "/cat_" + std::to_string(cid) + ".bin";
+    CriteoFile f;
+    f.fd = open(cp.c_str(), O_RDONLY);
+    f.elem_size = cat_elem_size(all_sizes[cid]);
+    if (f.fd < 0) { delete r; return nullptr; }
+    r->cats.push_back(f);
+  }
+  return r;
+}
+
+int64_t detpu_criteo_num_samples(void* handle) {
+  return ((CriteoReader*)handle)->num_samples;
+}
+
+static inline float half_to_float(uint16_t h) {
+  uint32_t sign = (uint32_t)(h >> 15) << 31;
+  uint32_t exp = (h >> 10) & 0x1F;
+  uint32_t mant = h & 0x3FF;
+  uint32_t bits;
+  if (exp == 0) {
+    if (mant == 0) { bits = sign; }
+    else {
+      // subnormal: normalize
+      int e = -1;
+      do { mant <<= 1; ++e; } while (!(mant & 0x400));
+      bits = sign | ((127 - 15 - e) << 23) | ((mant & 0x3FF) << 13);
+    }
+  } else if (exp == 31) {
+    bits = sign | 0x7F800000u | (mant << 13);
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float out;
+  memcpy(&out, &bits, 4);
+  return out;
+}
+
+// Read one batch at sample offset `start`, `batch` samples:
+//   labels_out [batch] f32, numerical_out [batch*num_numerical] f32,
+//   cats_out [num_cats * batch] i32 (feature-major).
+// Returns 0 on success.
+int detpu_criteo_read_batch(void* handle, int64_t start, int64_t batch,
+                            float* labels_out, float* numerical_out,
+                            int32_t* cats_out) {
+  CriteoReader* r = (CriteoReader*)handle;
+  if (start + batch > r->num_samples) return -1;
+
+  std::vector<uint8_t> buf;
+  buf.resize((size_t)batch * 4);
+
+  if (pread(r->label_fd, buf.data(), batch, start) != batch) return -2;
+  for (int64_t i = 0; i < batch; ++i) labels_out[i] = (float)buf[i];
+
+  if (r->numerical_fd >= 0) {
+    int64_t nbytes = batch * r->num_numerical * 2;
+    buf.resize(nbytes);
+    if (pread(r->numerical_fd, buf.data(), nbytes,
+              start * r->num_numerical * 2) != nbytes) return -3;
+    const uint16_t* h = (const uint16_t*)buf.data();
+    for (int64_t i = 0; i < batch * r->num_numerical; ++i)
+      numerical_out[i] = half_to_float(h[i]);
+  }
+
+  for (size_t c = 0; c < r->cats.size(); ++c) {
+    const CriteoFile& f = r->cats[c];
+    int64_t nbytes = batch * f.elem_size;
+    buf.resize(nbytes);
+    if (pread(f.fd, buf.data(), nbytes, start * f.elem_size) != nbytes)
+      return -4;
+    int32_t* out = cats_out + c * batch;
+    switch (f.elem_size) {
+      case 1: {
+        const int8_t* p = (const int8_t*)buf.data();
+        for (int64_t i = 0; i < batch; ++i) out[i] = p[i];
+        break;
+      }
+      case 2: {
+        const int16_t* p = (const int16_t*)buf.data();
+        for (int64_t i = 0; i < batch; ++i) out[i] = p[i];
+        break;
+      }
+      default: {
+        memcpy(out, buf.data(), nbytes);
+        break;
+      }
+    }
+  }
+  return 0;
+}
+
+void detpu_criteo_close(void* handle) {
+  CriteoReader* r = (CriteoReader*)handle;
+  if (r->label_fd >= 0) close(r->label_fd);
+  if (r->numerical_fd >= 0) close(r->numerical_fd);
+  for (auto& f : r->cats) close(f.fd);
+  delete r;
+}
+
+}  // extern "C"

@@ -3,3 +3,10 @@ synthetic benchmark models)."""
 
 from .dlrm import DLRM, DLRMConfig, dlrm_initializer, dot_interact
 from .schedules import warmup_poly_decay_schedule
+from .synthetic import (
+    InputGenerator,
+    SyntheticDense,
+    build_synthetic,
+    expand_embedding_configs,
+)
+from .synthetic_configs import synthetic_models_v3

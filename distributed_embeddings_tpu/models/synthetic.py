@@ -1,0 +1,154 @@
+"""Synthetic benchmark models.
+
+TPU re-design of the reference's benchmark model
+(``examples/benchmarks/synthetic_models/synthetic_models.py:116-243``):
+multi-hot sum-combiner embeddings (distributed), an optional
+average-pooling "interaction" that emulates memory-bound FM/pooling layers,
+and an MLP head. The dense half is a Flax module fed embedding activations,
+composable with :class:`~distributed_embeddings_tpu.parallel.DistributedEmbedding`
+via the hybrid trainer, like the DLRM example.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .synthetic_configs import ModelConfig
+
+
+def expand_embedding_configs(model_config: ModelConfig
+                             ) -> Tuple[List[dict], List[int], List[int]]:
+    """Flatten grouped ``EmbeddingConfig`` rows to per-table configs plus the
+    input→table map and per-input hotness (reference
+    ``synthetic_models.py:130-143``)."""
+    table_configs: List[dict] = []
+    input_table_map: List[int] = []
+    input_hotness: List[int] = []
+    for cfg in model_config.embedding_configs:
+        if len(cfg.nnz) > 1 and not cfg.shared:
+            raise NotImplementedError(
+                "Nonshared multihot embedding is not implemented yet")
+        for _ in range(cfg.num_tables):
+            table_id = len(table_configs)
+            table_configs.append({
+                "input_dim": int(cfg.num_rows),
+                "output_dim": int(cfg.width),
+                "combiner": "sum",
+            })
+            for hotness in cfg.nnz:
+                input_table_map.append(table_id)
+                input_hotness.append(int(hotness))
+    return table_configs, input_table_map, input_hotness
+
+
+def average_pool_1d(x: jax.Array, stride: int) -> jax.Array:
+    """SAME-padded 1-D average pooling over the feature axis with
+    window == stride (the reference's ``AveragePooling1D(...,
+    data_format='channels_first')`` applied to the concatenated embedding
+    vector, ``synthetic_models.py:151-155``)."""
+    b, t = x.shape
+    pad = (-t) % stride
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((b, pad), x.dtype)], axis=1)
+    # windows never cross the original boundary after SAME padding; average
+    # uses the true element count per window like Keras (count_includes_pad=False)
+    counts = jnp.concatenate(
+        [jnp.ones((t,), x.dtype), jnp.zeros((pad,), x.dtype)])
+    sums = x.reshape(b, -1, stride).sum(-1)
+    denom = jnp.maximum(counts.reshape(-1, stride).sum(-1), 1)
+    return sums / denom[None, :]
+
+
+class SyntheticDense(nn.Module):
+    """Dense half: optional pooled interaction + MLP head
+    (reference ``synthetic_models.py:150-175``)."""
+
+    mlp_sizes: Sequence[int]
+    interact_stride: Optional[int] = None
+
+    @nn.compact
+    def __call__(self, numerical_features: jax.Array,
+                 embedding_outputs: Sequence[jax.Array]) -> jax.Array:
+        cat = jnp.concatenate(
+            [e.reshape(e.shape[0], -1) for e in embedding_outputs], axis=1)
+        if self.interact_stride is not None:
+            cat = average_pool_1d(cat, self.interact_stride)
+        x = jnp.concatenate([cat, numerical_features], axis=1)
+        for size in self.mlp_sizes:
+            x = nn.relu(nn.Dense(size)(x))
+        return nn.Dense(1)(x)
+
+
+def build_synthetic(model_config: ModelConfig, world_size: int,
+                    strategy: str = "memory_balanced",
+                    column_slice_threshold: Optional[int] = None,
+                    row_cap: Optional[int] = None):
+    """Build ``(dist_embedding, dense_module, input_hotness)`` for a zoo model.
+
+    ``row_cap`` optionally clips table vocab sizes so the multi-TiB zoo scales
+    (reference ``config_v3.py``) can smoke-run on small hardware; benchmarks on
+    real pods run uncapped.
+    """
+    from ..parallel import DistributedEmbedding
+
+    table_configs, input_table_map, hotness = expand_embedding_configs(
+        model_config)
+    if row_cap is not None:
+        for cfg in table_configs:
+            cfg["input_dim"] = min(cfg["input_dim"], row_cap)
+    de = DistributedEmbedding(table_configs, world_size=world_size,
+                              strategy=strategy,
+                              column_slice_threshold=column_slice_threshold,
+                              input_table_map=input_table_map)
+    dense = SyntheticDense(mlp_sizes=tuple(model_config.mlp_sizes),
+                           interact_stride=model_config.interact_stride)
+    return de, dense, hotness
+
+
+class InputGenerator:
+    """Synthetic data-parallel batches: uniform or power-law ids
+    (reference ``InputGenerator``, ``synthetic_models.py:51-113``).
+
+    Yields ``(numerical [lbs, F], cats list of [lbs, hotness], labels
+    [lbs, 1])`` — ids over the full vocab (dp input; each device slice is
+    taken by the caller's sharding).
+    """
+
+    def __init__(self, model_config: ModelConfig, global_batch_size: int,
+                 alpha: float = 0.0, num_batches: int = 4, seed: int = 0,
+                 row_cap: Optional[int] = None):
+        from ..utils.data import power_law_ids
+        rng = np.random.default_rng(seed)
+        _, input_table_map, hotness = expand_embedding_configs(model_config)
+        table_configs, _, _ = expand_embedding_configs(model_config)
+        self.batches = []
+        for _ in range(num_batches):
+            cats = []
+            for inp, h in zip(input_table_map, hotness):
+                rows = table_configs[inp]["input_dim"]
+                if row_cap is not None:
+                    rows = min(rows, row_cap)
+                if alpha == 0.0:
+                    ids = rng.integers(0, rows, size=(global_batch_size, h))
+                else:
+                    ids = power_law_ids(rng, rows, (global_batch_size, h),
+                                        alpha)
+                cats.append(jnp.asarray(ids, jnp.int32))
+            numerical = jnp.asarray(
+                rng.random(size=(global_batch_size,
+                                 model_config.num_numerical_features)) * 100,
+                jnp.float32)
+            labels = jnp.asarray(
+                rng.integers(0, 2, size=(global_batch_size, 1)), jnp.float32)
+            self.batches.append((numerical, cats, labels))
+
+    def __len__(self):
+        return len(self.batches)
+
+    def __getitem__(self, idx):
+        return self.batches[idx % len(self.batches)]

@@ -1,0 +1,149 @@
+"""ctypes bindings for the native data runtime (``cc/libdetpu_dataio.so``).
+
+The reference loads its CUDA custom-op library at import
+(``python/ops/embedding_lookup_ops.py:23``); here the native piece is host
+data IO and it is optional — every entry point has a numpy fallback, so the
+package works without the compiled library (build with ``make -C cc``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+_LIB = None
+
+
+def _find_lib() -> Optional[ctypes.CDLL]:
+    here = os.path.dirname(os.path.abspath(__file__))
+    candidates = [
+        os.path.join(here, "..", "..", "cc", "libdetpu_dataio.so"),
+        os.path.join(here, "libdetpu_dataio.so"),
+    ]
+    for c in candidates:
+        if os.path.exists(c):
+            try:
+                lib = ctypes.CDLL(os.path.abspath(c))
+            except OSError:
+                continue
+            lib.detpu_power_law_ids.argtypes = [
+                ctypes.c_uint64, ctypes.c_double, ctypes.c_int64,
+                ctypes.c_int64, ctypes.POINTER(ctypes.c_int32)]
+            lib.detpu_uniform_ids.argtypes = [
+                ctypes.c_uint64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int32)]
+            lib.detpu_row_to_split.argtypes = [
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+                ctypes.c_int64, ctypes.POINTER(ctypes.c_int32)]
+            lib.detpu_criteo_open.restype = ctypes.c_void_p
+            lib.detpu_criteo_open.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int, ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+            lib.detpu_criteo_num_samples.restype = ctypes.c_int64
+            lib.detpu_criteo_num_samples.argtypes = [ctypes.c_void_p]
+            lib.detpu_criteo_read_batch.restype = ctypes.c_int
+            lib.detpu_criteo_read_batch.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_int32)]
+            lib.detpu_criteo_close.argtypes = [ctypes.c_void_p]
+            return lib
+    return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _LIB
+    if _LIB is None:
+        _LIB = _find_lib() or False
+    return _LIB or None
+
+
+def have_native() -> bool:
+    return get_lib() is not None
+
+
+def native_power_law_ids(seed: int, alpha: float, vocab: int,
+                         shape) -> Optional[np.ndarray]:
+    """Native power-law ids, or None when the library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = int(np.prod(shape))
+    out = np.empty(n, np.int32)
+    lib.detpu_power_law_ids(
+        ctypes.c_uint64(seed), ctypes.c_double(alpha), ctypes.c_int64(vocab),
+        ctypes.c_int64(n), out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return out.reshape(shape)
+
+
+def native_row_to_split(rows: np.ndarray, dim0: int) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    rows = np.ascontiguousarray(rows, np.int64)
+    out = np.empty(dim0 + 1, np.int32)
+    lib.detpu_row_to_split(
+        rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_int64(len(rows)), ctypes.c_int64(dim0),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return out
+
+
+class NativeCriteoReader:
+    """Criteo split-binary reader backed by the C library.
+
+    Same file format as :class:`~distributed_embeddings_tpu.utils.data.RawBinaryDataset`
+    (and the reference's, ``examples/dlrm/utils.py:157-307``); this path does
+    the dtype widening (bool→f32, f16→f32, int8/16→i32) in C.
+    """
+
+    def __init__(self, split_dir: str, cat_ids: Sequence[int],
+                 all_sizes: Sequence[int], num_numerical: int):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError(
+                "native library not built; run `make -C cc` or use "
+                "RawBinaryDataset")
+        self._lib = lib
+        self._num_numerical = num_numerical
+        self._num_cats = len(cat_ids)
+        cat_arr = np.asarray(cat_ids, np.int32)
+        size_arr = np.asarray(all_sizes, np.int64)
+        self._h = lib.detpu_criteo_open(
+            split_dir.encode(), cat_arr.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_int32)),
+            len(cat_ids),
+            size_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            num_numerical)
+        if not self._h:
+            raise FileNotFoundError(f"cannot open criteo files in {split_dir}")
+        self.num_samples = lib.detpu_criteo_num_samples(self._h)
+
+    def read(self, start: int, batch: int):
+        labels = np.empty(batch, np.float32)
+        numerical = np.empty(batch * self._num_numerical, np.float32)
+        cats = np.empty(self._num_cats * batch, np.int32)
+        rc = self._lib.detpu_criteo_read_batch(
+            self._h, ctypes.c_int64(start), ctypes.c_int64(batch),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            numerical.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            cats.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if rc != 0:
+            raise IOError(f"criteo read failed with code {rc}")
+        return (numerical.reshape(batch, self._num_numerical),
+                [cats[c * batch:(c + 1) * batch] for c in range(self._num_cats)],
+                labels.reshape(batch, 1))
+
+    def close(self):
+        if self._h:
+            self._lib.detpu_criteo_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # pylint: disable=broad-except
+            pass

@@ -1,0 +1,71 @@
+"""Single-op embedding lookup microbenchmark.
+
+TPU port of the reference microbenchmark
+(``examples/benchmarks/benchmark.py:23-98``): times forward, forward+backward
+and forward+backward+SGD of the fused ragged variable-hotness lookup against
+the unfused dense gather+reduce formulation.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from absl import app, flags
+
+from distributed_embeddings_tpu.ops import Ragged, embedding_lookup
+
+FLAGS = flags.FLAGS
+flags.DEFINE_integer("batch_size", 65536, "batch size")
+flags.DEFINE_integer("vocab", 1000000, "table rows")
+flags.DEFINE_integer("width", 128, "embedding width")
+flags.DEFINE_integer("hotness", 10, "average ids per sample")
+flags.DEFINE_integer("iters", 50, "timed iterations")
+
+
+def timeit(fn, *args, iters):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main(_):
+    b, v, w, h = FLAGS.batch_size, FLAGS.vocab, FLAGS.width, FLAGS.hotness
+    rng = np.random.default_rng(0)
+    params = jnp.asarray(rng.normal(size=(v, w)), jnp.float32)
+    # variable hotness in [1, 2h-1], mean h (reference generates variable rows)
+    hots = rng.integers(1, 2 * h, size=b)
+    total = int(hots.sum())
+    values = jnp.asarray(rng.integers(0, v, size=total), jnp.int32)
+    splits = jnp.asarray(np.concatenate([[0], np.cumsum(hots)]), jnp.int32)
+    ragged = Ragged(values=values, row_splits=splits)
+    dense_ids = jnp.asarray(rng.integers(0, v, size=(b, h)), jnp.int32)
+
+    fwd = jax.jit(lambda p, r: embedding_lookup(p, r, combiner="sum"))
+    print(f"ragged fwd:           {timeit(fwd, params, ragged, iters=FLAGS.iters):8.3f} ms")
+
+    dfwd = jax.jit(lambda p, i: embedding_lookup(p, i, combiner="sum"))
+    print(f"dense  fwd:           {timeit(dfwd, params, dense_ids, iters=FLAGS.iters):8.3f} ms")
+
+    grad = jax.jit(jax.grad(lambda p, r: embedding_lookup(p, r, combiner="sum").sum()))
+    print(f"ragged fwd+bwd:       {timeit(grad, params, ragged, iters=FLAGS.iters):8.3f} ms")
+
+    sgd = jax.jit(lambda p, r: p - 0.01 * jax.grad(
+        lambda q: embedding_lookup(q, r, combiner="sum").sum())(p),
+        donate_argnums=0)
+    p2 = jnp.array(params)
+    out = sgd(p2, ragged)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(FLAGS.iters):
+        out = sgd(out, ragged)
+    jax.block_until_ready(out)
+    print(f"ragged fwd+bwd+sgd:   {(time.perf_counter()-t0)/FLAGS.iters*1e3:8.3f} ms")
+
+
+if __name__ == "__main__":
+    app.run(main)

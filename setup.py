@@ -1,0 +1,36 @@
+"""Package build (reference: ``setup.py`` + ``build_pip_pkg.sh``).
+
+The TPU build has no CUDA compilation step; the optional native data-loader
+extension under ``cc/`` builds with ``make -C cc`` (see Makefile) and is
+loaded via ctypes with a pure-python fallback, so the wheel works without it.
+"""
+
+import os
+
+from setuptools import find_packages, setup
+
+
+def read_version():
+    here = os.path.dirname(os.path.abspath(__file__))
+    scope = {}
+    with open(os.path.join(here, "distributed_embeddings_tpu", "version.py"),
+              encoding="utf-8") as f:
+        exec(f.read(), scope)  # noqa: S102 - own file
+    return scope["__version__"]
+
+
+setup(
+    name="distributed-embeddings-tpu",
+    version=read_version(),
+    description=("TPU-native large-embedding recommender training: "
+                 "hybrid model/data-parallel embedding layers on JAX/XLA"),
+    packages=find_packages(exclude=("tests", "examples")),
+    package_data={"distributed_embeddings_tpu": ["cc/*.so"]},
+    python_requires=">=3.10",
+    install_requires=[
+        "jax",
+        "flax",
+        "optax",
+        "numpy",
+    ],
+)

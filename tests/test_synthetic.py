@@ -1,0 +1,84 @@
+"""Synthetic model zoo tests (reference:
+``examples/benchmarks/synthetic_models/``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh
+
+from distributed_embeddings_tpu.models import (
+    InputGenerator,
+    build_synthetic,
+    expand_embedding_configs,
+    synthetic_models_v3,
+)
+from distributed_embeddings_tpu.models.synthetic import average_pool_1d
+from distributed_embeddings_tpu.parallel import (
+    SparseAdagrad,
+    init_hybrid_state,
+    make_hybrid_train_step,
+)
+
+
+def test_zoo_scales_match_reference():
+    """Table counts from the reference README: 55..2002 tables."""
+    expected = {"tiny": 55, "small": 107, "medium": 311, "large": 612,
+                "jumbo": 1022, "colossal": 2002}
+    for name, count in expected.items():
+        cfgs, table_map, hotness = expand_embedding_configs(
+            synthetic_models_v3[name])
+        assert len(cfgs) == count, name
+        assert len(table_map) == len(hotness)
+
+
+def test_expand_shared_tables():
+    cfgs, table_map, hotness = expand_embedding_configs(
+        synthetic_models_v3["tiny"])
+    # first group: 1 table shared by inputs of hotness 1 and 10
+    assert table_map[0] == table_map[1] == 0
+    assert hotness[0] == 1 and hotness[1] == 10
+
+
+def test_average_pool_1d():
+    x = jnp.asarray(np.arange(12, dtype=np.float32).reshape(2, 6))
+    out = average_pool_1d(x, 4)
+    # windows [0..3] avg, [4,5] avg over true count 2
+    np.testing.assert_allclose(out, [[1.5, 4.5], [7.5, 10.5]])
+
+
+def test_tiny_trains_on_mesh():
+    world = 8
+    mesh = Mesh(np.array(jax.devices()[:world]), ("data",))
+    model_cfg = synthetic_models_v3["tiny"]
+    de, dense, hotness = build_synthetic(model_cfg, world, row_cap=1000,
+                                         column_slice_threshold=8000)
+    B = world * 4
+    gen = InputGenerator(model_cfg, B, alpha=1.05, num_batches=2,
+                         row_cap=1000)
+    num0, cats0, labels0 = gen[0]
+    out_widths = [int(de.strategy.global_configs[t]["output_dim"])
+                  for t in de.strategy.input_table_map]
+    dense_params = dense.init(
+        jax.random.key(0), num0[:2],
+        [jnp.zeros((2, w), jnp.float32) for w in out_widths])
+
+    emb_opt = SparseAdagrad()
+    tx = optax.adagrad(0.05)
+
+    def loss_fn(dp, emb_outs, batch):
+        n, y = batch
+        return jnp.mean((dense.apply(dp, n, emb_outs) - y) ** 2)
+
+    state = init_hybrid_state(de, emb_opt, dense_params, tx,
+                              jax.random.key(1), mesh=mesh)
+    step_fn = make_hybrid_train_step(de, loss_fn, tx, emb_opt, mesh=mesh,
+                                     lr_schedule=0.05)
+    losses = []
+    for i in range(6):
+        num, cats, labels = gen[i]
+        loss, state = step_fn(state, cats, (num, labels))
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
