@@ -7,7 +7,7 @@ runtime communication (Horovod all-to-all/allreduce in the reference) becomes
 """
 
 from .strategy import DistEmbeddingStrategy
-from .dist_embedding import DistributedEmbedding
+from .dist_embedding import DistributedEmbedding, MpInputs
 from .grads import (
     broadcast_variables,
     hybrid_gradients,
@@ -16,4 +16,9 @@ from .grads import (
     split_mp_dp,
 )
 from .optimizers import SparseAdagrad, SparseSGD
-from .trainer import HybridTrainState, init_hybrid_state, make_hybrid_train_step
+from .trainer import (
+    HybridTrainState,
+    init_hybrid_state,
+    make_hybrid_eval_step,
+    make_hybrid_train_step,
+)

@@ -40,6 +40,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+from flax import struct
 from jax import lax
 
 from ..layers.embedding import default_embeddings_init
@@ -47,6 +48,32 @@ from ..ops.embedding_lookup import embedding_lookup
 from .strategy import DistEmbeddingStrategy
 
 EmbedParams = Dict[str, jax.Array]
+
+
+@struct.dataclass
+class MpInputs:
+    """Model-parallel input batch (``dp_input=False``).
+
+    The reference's mp-input mode feeds each rank its *local* tables' ids for
+    the full global batch, skipping the dp→mp id all-to-all entirely
+    (``dist_model_parallel.py:213,267-288``; the DLRM example's default input
+    path, ``examples/dlrm/main.py:57,161-190``). In SPMD form that per-rank
+    block is exactly the ``ids_recv`` layout the dp path's all-to-all would
+    have produced, packed once on host by :meth:`DistributedEmbedding.pack_mp_inputs`:
+
+    * ``packed``: ``[world_dest, world_src, l_max]`` globally (shard over the
+      mesh axis on dim 0; inside ``shard_map`` each device sees
+      ``[1, world_src, l_max]``). Row ``[r, s]`` holds source-shard ``s``'s
+      local batch of ids for every input owned by rank ``r``, concatenated in
+      ``input_ids_list[r]`` order and zero-padded to ``l_max``.
+    * ``hots``: static per-global-input hotness (all ranks compile all switch
+      branches, so hotness must be globally known).
+    * ``local_batch``: static per-shard batch size ``b``.
+    """
+
+    packed: jax.Array
+    hots: tuple = struct.field(pytree_node=False)
+    local_batch: int = struct.field(pytree_node=False)
 
 
 def _out_width(config, hotness: int) -> int:
@@ -85,8 +112,10 @@ class DistributedEmbedding:
       row_slice: reserved (the reference declares-but-does-not-implement row
         slicing, ``dist_model_parallel.py:225,233-234``).
       dp_input: if True (default) inputs are data-parallel shards
-        ``[local_batch, ...]`` per global feature. Model-parallel input is not
-        yet wired in the SPMD executor.
+        ``[local_batch, ...]`` per global feature. If False, inputs are
+        model-parallel: a :class:`MpInputs` built by :meth:`pack_mp_inputs`
+        (each rank holds the full global batch of ids for its local tables;
+        no id all-to-all runs).
       input_table_map: ``input[i]`` uses ``table[input_table_map[i]]``.
       axis_name: mesh axis the executor runs under (inside ``shard_map``).
     """
@@ -102,10 +131,6 @@ class DistributedEmbedding:
                  axis_name: str = "data"):
         if row_slice is not None:
             raise NotImplementedError("Row slicing embedding is not supported yet!")
-        if not dp_input:
-            raise NotImplementedError(
-                "Model-parallel input is not supported by the SPMD executor yet; "
-                "use dp_input=True")
         self.world_size = int(world_size)
         self.axis_name = axis_name
         self.dp_input = dp_input
@@ -227,6 +252,91 @@ class DistributedEmbedding:
             out.append(inp[:, None] if inp.ndim == 1 else inp)
         return out, was_1d
 
+    def pack_mp_inputs(self, inputs, dtype=None, mesh=None,
+                       hots: Optional[Sequence[int]] = None,
+                       local_batch: Optional[int] = None) -> MpInputs:
+        """Pack per-feature global-batch id arrays into :class:`MpInputs`.
+
+        ``inputs[i]`` is ``[global_batch]`` or ``[global_batch, hotness]`` for
+        global input ``i``, ordered by data-parallel shard (shard ``s`` owns
+        rows ``s*b:(s+1)*b``) — the natural order of a global batch. Host-side
+        numpy; with ``mesh`` given the packed array is laid out sharded over
+        ``axis_name`` so each device receives only its own block.
+
+        On a multi-host data pipeline each process only needs the features its
+        ranks own (reference ``examples/dlrm/main.py:166-176`` reads only the
+        local tables' ``cat_*.bin``); entries for other ranks' features may be
+        ``None`` — their packed blocks live on other processes' devices. In
+        that case pass ``hots`` (per-input hotness of ALL inputs) and, if
+        every entry is None, ``local_batch`` too: the packed layout must be
+        identical on every process, so it cannot be inferred from local
+        arrays alone.
+
+        Args:
+          dtype: id dtype of the packed block; default promotes like the dp
+            path (int64 if any provided array is int64, else int32).
+        """
+        world = self.world_size
+        arrs = [None if x is None else np.asarray(x) for x in inputs]
+        if len(arrs) != self.strategy.num_inputs:
+            raise ValueError(
+                f"Expected {self.strategy.num_inputs} inputs, got {len(arrs)}")
+        some = next((a for a in arrs if a is not None), None)
+        if some is None:
+            if local_batch is None or hots is None:
+                raise ValueError(
+                    "pack_mp_inputs with all-None inputs needs explicit "
+                    "hots= and local_batch= (layout must match the owning "
+                    "processes)")
+            b = int(local_batch)
+        else:
+            gb = some.shape[0]
+            if gb % world:
+                raise ValueError(
+                    f"Global batch {gb} not divisible by world size {world}")
+            b = gb // world
+            if local_batch is not None and int(local_batch) != b:
+                raise ValueError(
+                    f"local_batch={local_batch} contradicts inputs ({b})")
+        if dtype is None:
+            dtype = (jnp.int64 if any(a is not None and a.dtype == np.int64
+                                      for a in arrs) else jnp.int32)
+        arrs = [None if a is None else (a[:, None] if a.ndim == 1 else a)
+                for a in arrs]
+        if hots is None:
+            if any(a is None for a in arrs):
+                raise ValueError(
+                    "pack_mp_inputs with None entries needs explicit hots= "
+                    "(hotness of every input must be globally known)")
+            hots = tuple(int(a.shape[1]) for a in arrs)
+        else:
+            hots = tuple(int(h) for h in hots)
+            for i, a in enumerate(arrs):
+                if a is not None and a.shape[1] != hots[i]:
+                    raise ValueError(
+                        f"Input {i} hotness {a.shape[1]} != hots[{i}]={hots[i]}")
+        l_max = max(max(b * sum(hots[i] for i in ids)
+                        for ids in self.strategy.input_ids_list), 1)
+        rows = []
+        for ids in self.strategy.input_ids_list:
+            parts = []
+            for i in ids:
+                if arrs[i] is None:
+                    parts.append(np.zeros((world, b * hots[i]), np.int32))
+                else:
+                    parts.append(arrs[i].reshape(world, b * hots[i]))
+            blk = (np.concatenate(parts, axis=1) if parts
+                   else np.zeros((world, 0), np.int32))
+            if blk.shape[1] < l_max:
+                blk = np.pad(blk, ((0, 0), (0, l_max - blk.shape[1])))
+            rows.append(blk)
+        packed = jnp.asarray(np.stack(rows), dtype)  # [dest, src, l_max]
+        if mesh is not None:
+            sharding = jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec(self.axis_name))
+            packed = jax.device_put(packed, sharding)
+        return MpInputs(packed=packed, hots=hots, local_batch=b)
+
     def _lookup_local(self, params: EmbedParams, rank: int,
                       inputs: Sequence[jax.Array],
                       flatten_2d: bool) -> List[jax.Array]:
@@ -266,9 +376,13 @@ class DistributedEmbedding:
         (``embedding_lookup_ops.py:116-122``).
         """
         params = self.local_view(params)
-        inputs, was_1d = self._normalize_inputs(inputs)
 
         if self.world_size == 1:
+            if isinstance(inputs, MpInputs):
+                raise ValueError(
+                    "world_size == 1 takes a plain input list (mp and dp "
+                    "input coincide)")
+            inputs, was_1d = self._normalize_inputs(inputs)
             outs = self._lookup_local(params, 0, inputs, flatten_2d=False)
             # reference parity: a 1-D no-combiner input yields [batch, width]
             outs = [o[:, 0, :] if (sq and o.ndim == 3 and o.shape[1] == 1)
@@ -276,31 +390,53 @@ class DistributedEmbedding:
             return outs, ("local", inputs)
 
         world = self.world_size
-        b = inputs[0].shape[0]
-        for inp in inputs:
-            if inp.shape[0] != b:
-                raise ValueError("All inputs must share the batch dimension")
-        hots = [int(inp.shape[1]) for inp in inputs]
-        comm_dtype = inputs[0].dtype
+        if self.dp_input:
+            inputs, _ = self._normalize_inputs(inputs)
+            b = inputs[0].shape[0]
+            for inp in inputs:
+                if inp.shape[0] != b:
+                    raise ValueError("All inputs must share the batch dimension")
+            hots = [int(inp.shape[1]) for inp in inputs]
+            comm_dtype = inputs[0].dtype
 
-        # --- dp -> mp id exchange ------------------------------------------
-        # Block for dest rank r: its inputs flattened and concatenated
-        # (reference :273-282), padded to the max block length.
-        block_lens = [b * sum(hots[i] for i in ids)
-                      for ids in self.strategy.input_ids_list]
-        l_max = max(max(block_lens), 1)
-        blocks = []
-        for ids in self.strategy.input_ids_list:
-            if ids:
-                blk = jnp.concatenate([inputs[i].reshape(-1) for i in ids])
-            else:
-                blk = jnp.zeros((0,), comm_dtype)
-            if blk.shape[0] < l_max:
-                blk = jnp.concatenate(
-                    [blk, jnp.zeros((l_max - blk.shape[0],), comm_dtype)])
-            blocks.append(blk)
-        ids_send = jnp.stack(blocks)  # [world, l_max]
-        ids_recv = lax.all_to_all(ids_send, self.axis_name, 0, 0, tiled=True)
+            # --- dp -> mp id exchange --------------------------------------
+            # Block for dest rank r: its inputs flattened and concatenated
+            # (reference :273-282), padded to the max block length.
+            block_lens = [b * sum(hots[i] for i in ids)
+                          for ids in self.strategy.input_ids_list]
+            l_max = max(max(block_lens), 1)
+            blocks = []
+            for ids in self.strategy.input_ids_list:
+                if ids:
+                    blk = jnp.concatenate([inputs[i].reshape(-1) for i in ids])
+                else:
+                    blk = jnp.zeros((0,), comm_dtype)
+                if blk.shape[0] < l_max:
+                    blk = jnp.concatenate(
+                        [blk, jnp.zeros((l_max - blk.shape[0],), comm_dtype)])
+                blocks.append(blk)
+            ids_send = jnp.stack(blocks)  # [world, l_max]
+            ids_recv = lax.all_to_all(ids_send, self.axis_name, 0, 0, tiled=True)
+        else:
+            # --- model-parallel input: this rank already holds the global
+            # batch of ids for its local tables; no id exchange runs
+            # (reference :213,267: mp input skips the alltoall entirely).
+            if not isinstance(inputs, MpInputs):
+                raise ValueError(
+                    "dp_input=False requires an MpInputs batch; build one "
+                    "with pack_mp_inputs()")
+            if len(inputs.hots) != self.strategy.num_inputs:
+                raise ValueError(
+                    f"Expected {self.strategy.num_inputs} hotness entries, "
+                    f"got {len(inputs.hots)}")
+            hots = [int(h) for h in inputs.hots]
+            b = int(inputs.local_batch)
+            ids_recv = inputs.packed
+            if ids_recv.ndim == 3:  # [1, world, l_max] shard inside shard_map
+                ids_recv = ids_recv.reshape(ids_recv.shape[-2],
+                                            ids_recv.shape[-1])
+            if not jnp.issubdtype(ids_recv.dtype, jnp.integer):
+                ids_recv = ids_recv.astype(jnp.int32)
 
         # --- rank-specialized local lookup (lax.switch over mesh position) --
         out_widths_list = [

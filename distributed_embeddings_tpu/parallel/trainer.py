@@ -110,6 +110,43 @@ def make_hybrid_train_step(de: DistributedEmbedding,
     return jax.jit(sm, donate_argnums=(0,))
 
 
+def make_hybrid_eval_step(de: DistributedEmbedding,
+                          pred_fn: Callable,
+                          mesh=None):
+    """Build ``eval_step(state, cat_inputs, batch) -> global predictions``.
+
+    The inference analogue of :func:`make_hybrid_train_step` — the reference
+    evaluates by running the forward under Horovod and allgathering per-rank
+    predictions (``examples/dlrm/main.py:230-243`` there); here the shard_map
+    output spec ``P(axis)`` reassembles the global prediction array directly.
+
+    Args:
+      de: the distributed embedding layer.
+      pred_fn: ``pred_fn(dense_params, emb_outputs, batch) -> predictions``
+        over the per-device batch shard.
+      mesh: required when ``de.world_size > 1``.
+    """
+    world = de.world_size
+
+    def local_eval(state: HybridTrainState, cat_inputs, batch):
+        outs = de(state.emb_params, cat_inputs)
+        return pred_fn(state.dense_params, outs, batch)
+
+    if world == 1:
+        return jax.jit(local_eval)
+    if mesh is None:
+        raise ValueError("mesh is required for world_size > 1")
+    ax = de.axis_name
+    state_specs = HybridTrainState(
+        emb_params=P(ax), emb_opt_state=P(ax),
+        dense_params=P(), dense_opt_state=P(), step=P())
+    sm = jax.shard_map(
+        local_eval, mesh=mesh,
+        in_specs=(state_specs, P(ax), P(ax)),
+        out_specs=P(ax))
+    return jax.jit(sm)
+
+
 def init_hybrid_state(de: DistributedEmbedding, emb_optimizer,
                       dense_params, dense_tx, key, mesh=None,
                       dtype=jnp.float32) -> HybridTrainState:

@@ -27,7 +27,8 @@ from distributed_embeddings_tpu.models.dlrm import (
 from distributed_embeddings_tpu.models.schedules import (
     warmup_poly_decay_schedule)
 from distributed_embeddings_tpu.parallel import (
-    DistributedEmbedding, SparseSGD, init_hybrid_state, make_hybrid_train_step)
+    DistributedEmbedding, SparseSGD, init_hybrid_state, make_hybrid_eval_step,
+    make_hybrid_train_step)
 from distributed_embeddings_tpu.utils import (
     RawBinaryDataset, binary_auc, power_law_ids)
 
@@ -52,6 +53,10 @@ flags.DEFINE_integer("column_slice_threshold", None,
                      "max elements per table slice")
 flags.DEFINE_string("checkpoint_out", "/tmp/embedding_weights",
                     "np.savez path for final global embedding weights")
+flags.DEFINE_bool("dp_input", False,
+                  "feed data-parallel id shards through the dp->mp exchange; "
+                  "False (default, like the reference example) feeds "
+                  "model-parallel input, skipping the id all-to-all")
 
 
 def synthetic_batches(cfg, num_batches, batch_size, seed=0):
@@ -85,9 +90,12 @@ def main(_):
     world = len(devices)
     mesh = (jax.sharding.Mesh(np.array(devices), ("data",))
             if world > 1 else None)
+    # mp input only means anything on a real mesh
+    use_mp_input = (not FLAGS.dp_input) and world > 1
     de = DistributedEmbedding(cfg.embedding_configs(),
                               world_size=world,
                               strategy=FLAGS.dist_strategy,
+                              dp_input=not use_mp_input,
                               column_slice_threshold=FLAGS.column_slice_threshold)
     dense = DLRMDense(cfg)
     print(de.strategy.describe())
@@ -115,38 +123,48 @@ def main(_):
     step_fn = make_hybrid_train_step(de, loss_fn, tx, emb_opt, mesh=mesh,
                                      lr_schedule=sched)
 
+    def prep_cats(cats):
+        """Global per-feature id arrays -> the executor's input format."""
+        if use_mp_input:
+            return de.pack_mp_inputs(cats, mesh=mesh)
+        return [jnp.asarray(c) for c in cats]
+
     if FLAGS.dataset_path is not None:
+        # mp input reads full global batches per feature and packs them
+        # per-rank; on a multi-host launch each process would restrict
+        # categorical_features to its local ranks' tables (reference
+        # main.py:166-176).
         train_data = RawBinaryDataset(
             data_path=FLAGS.dataset_path, batch_size=FLAGS.batch_size,
             numerical_features=FLAGS.num_numerical_features,
             categorical_features=list(range(len(table_sizes))),
             categorical_feature_sizes=table_sizes,
-            drop_last_batch=True, dp_input=True)
+            drop_last_batch=True, dp_input=not use_mp_input)
         eval_data = RawBinaryDataset(
             data_path=FLAGS.dataset_path, batch_size=FLAGS.batch_size,
             numerical_features=FLAGS.num_numerical_features,
             categorical_features=list(range(len(table_sizes))),
             categorical_feature_sizes=table_sizes,
-            drop_last_batch=True, valid=True, dp_input=True)
-        train_iter = ((jnp.asarray(n), [jnp.asarray(c) for c in cs],
-                       jnp.asarray(y)) for n, cs, y in train_data)
+            drop_last_batch=True, valid=True, dp_input=not use_mp_input)
+        train_iter = ((jnp.asarray(n), cs, jnp.asarray(y))
+                      for n, cs, y in train_data)
     else:
         train_iter = synthetic_batches(cfg, FLAGS.num_batches,
                                        FLAGS.batch_size)
         eval_data = None
 
     for step, (num, cats, labels) in enumerate(train_iter):
-        loss, state = step_fn(state, cats, (num, labels))
+        loss, state = step_fn(state, prep_cats(cats), (num, labels))
         if step % 1000 == 0:
             print("step:", step, " loss:", float(loss))
 
     if eval_data is not None:
+        eval_fn = make_hybrid_eval_step(
+            de, lambda dp, outs, n: jax.nn.sigmoid(dense.apply(dp, n, outs)),
+            mesh=mesh)
         all_preds, all_labels = [], []
-        fwd = jax.jit(lambda emb, dp, n, cats_: jax.nn.sigmoid(
-            dense.apply(dp, n, de(emb, cats_))))
         for num, cats, labels in eval_data:
-            preds = fwd(state.emb_params, state.dense_params,
-                        jnp.asarray(num), [jnp.asarray(c) for c in cats])
+            preds = eval_fn(state, prep_cats(cats), jnp.asarray(num))
             all_preds.append(np.asarray(preds))
             all_labels.append(np.asarray(labels))
         auc = binary_auc(np.concatenate(all_labels),

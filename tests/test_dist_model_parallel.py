@@ -240,6 +240,86 @@ def test_rank_with_no_inputs(mesh):
                                    rtol=1e-5, atol=1e-6)
 
 
+def dist_forward_mp_fn(de, mesh):
+    """Forward for model-parallel input: the MpInputs pytree shards over the
+    mesh axis (its packed [dest, src, l_max] leading dim)."""
+    def fwd(params, mp_in):
+        return tuple(de(params, mp_in))
+
+    return jax.jit(jax.shard_map(
+        fwd, mesh=mesh, in_specs=(P("data"), P("data")),
+        out_specs=P("data")))
+
+
+@pytest.mark.parametrize("strategy", ["basic", "memory_balanced",
+                                      "memory_optimized"])
+@pytest.mark.parametrize("column_slice_threshold", [None, 150])
+def test_mp_input_forward_matches_reference(mesh, strategy,
+                                            column_slice_threshold):
+    """dp_input=False forward parity (reference
+    ``dist_model_parallel_test.py:129-134``: the mp-input mode of every
+    strategy)."""
+    rng = np.random.default_rng(SEEDS[strategy] + 1)
+    configs, input_table_map = random_model(rng)
+    de = DistributedEmbedding(configs, world_size=WORLD, strategy=strategy,
+                              column_slice_threshold=column_slice_threshold,
+                              input_table_map=input_table_map, dp_input=False)
+    flat = de.init(jax.random.key(0), mesh=mesh)
+    tables = de.get_weights(flat)
+
+    inputs = make_inputs(rng, configs, input_table_map, global_batch=WORLD * 4,
+                         multihot_nocombiner=column_slice_threshold is None)
+    expect = reference_forward(tables, configs, input_table_map, inputs)
+
+    mp_in = de.pack_mp_inputs(inputs, mesh=mesh)
+    outs = dist_forward_mp_fn(de, mesh)(flat, mp_in)
+    assert len(outs) == len(expect)
+    for o, e in zip(outs, expect):
+        np.testing.assert_allclose(np.asarray(o), np.asarray(e),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_mp_input_sgd_step_matches_reference(mesh):
+    """One SGD step under mp input equals the single-device oracle step
+    (reference ``dist_model_parallel_test.py:199-215``)."""
+    rng = np.random.default_rng(29)
+    configs, input_table_map = random_model(rng, num_tables=10)
+    de = DistributedEmbedding(configs, world_size=WORLD,
+                              strategy="memory_balanced",
+                              column_slice_threshold=200,
+                              input_table_map=input_table_map, dp_input=False)
+    tables0 = [rng.normal(size=(c["input_dim"], c["output_dim"])
+                          ).astype(np.float32) for c in configs]
+    flat = de.set_weights(tables0, mesh=mesh)
+    inputs = make_inputs(rng, configs, input_table_map, global_batch=WORLD * 4)
+    mp_in = de.pack_mp_inputs(inputs, mesh=mesh)
+    lr = 0.5
+
+    def local_loss(params, mp_in_):
+        outs = de(params, mp_in_)
+        return sum(jnp.mean(o ** 2) for o in outs)
+
+    def step(params, mp_in_):
+        loss, grads = hybrid_value_and_grad(
+            local_loss, mp_mask=True, axis_name="data")(params, mp_in_)
+        return jax.tree.map(lambda p, g: p - lr * g, params, grads)
+
+    new_flat = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P("data"), P("data")),
+        out_specs=P("data")))(flat, mp_in)
+    dist_tables = de.get_weights(new_flat)
+
+    def ref_loss(tables):
+        outs = reference_forward(tables, configs, input_table_map, inputs)
+        return sum(jnp.mean(o ** 2) for o in outs)
+
+    ref_grads = jax.grad(ref_loss)([jnp.asarray(t) for t in tables0])
+    ref_tables = [t - lr * g for t, g in zip(tables0, ref_grads)]
+    for a, b in zip(dist_tables, ref_tables):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
 def test_world_size_one_passthrough():
     configs = [{"input_dim": 10, "output_dim": 4, "combiner": "sum"},
                {"input_dim": 8, "output_dim": 2, "combiner": None}]

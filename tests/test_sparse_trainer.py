@@ -26,7 +26,8 @@ from distributed_embeddings_tpu.parallel import (
 WORLD = 8
 
 
-def setup_model(rng, num_tables=10, world=WORLD, column_slice_threshold=None):
+def setup_model(rng, num_tables=10, world=WORLD, column_slice_threshold=None,
+                dp_input=True):
     configs = []
     for _ in range(num_tables):
         configs.append({
@@ -36,7 +37,8 @@ def setup_model(rng, num_tables=10, world=WORLD, column_slice_threshold=None):
         })
     de = DistributedEmbedding(configs, world_size=world,
                               strategy="memory_balanced",
-                              column_slice_threshold=column_slice_threshold)
+                              column_slice_threshold=column_slice_threshold,
+                              dp_input=dp_input)
     tables = [rng.normal(size=(c["input_dim"], c["output_dim"])
                          ).astype(np.float32) for c in configs]
     return configs, de, tables
@@ -132,4 +134,45 @@ def test_sparse_trainer_matches_dense_optax(opt_name, world):
     np.testing.assert_allclose(np.asarray(state.dense_params["w"]),
                                np.asarray(oracle["dense"]["w"]),
                                rtol=2e-4, atol=1e-5)
+    assert losses[-1] < losses[0]
+
+
+def test_sparse_trainer_mp_input_matches_dense_optax():
+    """The manual sparse backward under model-parallel input (dp_input=False):
+    the reverse output all-to-all + scatter updates must still reproduce the
+    dense-autodiff optax trajectory when the id exchange never ran."""
+    rng = np.random.default_rng(43)
+    configs, de, tables0 = setup_model(rng, world=WORLD,
+                                       column_slice_threshold=300,
+                                       dp_input=False)
+    mesh = Mesh(np.array(jax.devices()[:WORLD]), ("data",))
+    lr = 0.3
+    emb_opt, emb_tx = SparseAdagrad(), optax.adagrad(lr)
+
+    B = 16 * WORLD
+    cats, labels, total_w = make_batch(rng, configs, B)
+    mp_in = de.pack_mp_inputs(cats, mesh=mesh)
+    dense0_np = rng.normal(size=(total_w, 1)).astype(np.float32) * 0.3
+    dense0 = {"w": jnp.asarray(dense0_np)}
+
+    flat = de.set_weights(tables0, mesh=mesh)
+    state = HybridTrainState(
+        emb_params=flat,
+        emb_opt_state=emb_opt.init(flat),
+        dense_params=dense0,
+        dense_opt_state=optax.sgd(0.1).init(dense0),
+        step=jnp.zeros((), jnp.int32))
+    step_fn = make_hybrid_train_step(
+        de, dense_loss, optax.sgd(0.1), emb_opt, mesh=mesh, lr_schedule=lr)
+
+    losses = []
+    for _ in range(3):
+        loss, state = step_fn(state, mp_in, labels)
+        losses.append(float(loss))
+
+    oracle = oracle_trajectory(configs, tables0, {"w": jnp.asarray(dense0_np)},
+                               cats, labels, emb_tx, steps=3, lr=lr)
+    for got, want in zip(de.get_weights(state.emb_params), oracle["tables"]):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=1e-5)
     assert losses[-1] < losses[0]
