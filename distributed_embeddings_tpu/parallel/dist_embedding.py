@@ -118,6 +118,13 @@ class DistributedEmbedding:
         no id all-to-all runs).
       input_table_map: ``input[i]`` uses ``table[input_table_map[i]]``.
       axis_name: mesh axis the executor runs under (inside ``shard_map``).
+      compute_dtype: output/communication dtype. Embedding reads and combiner
+        reductions stay in the parameter dtype; outputs are cast to
+        ``compute_dtype`` *before* the mp→dp all-to-all — the reference's
+        mixed-precision pre-comm cast (``dist_model_parallel.py:300,499``) —
+        halving exchange bytes with bf16. Backward cotangents arrive in
+        ``compute_dtype``, ride the reverse exchange, and are cast back up at
+        the optimizer scatter. ``None`` keeps the parameter dtype end-to-end.
     """
 
     def __init__(self,
@@ -128,12 +135,14 @@ class DistributedEmbedding:
                  row_slice: Optional[Any] = None,
                  dp_input: bool = True,
                  input_table_map: Optional[Sequence[int]] = None,
-                 axis_name: str = "data"):
+                 axis_name: str = "data",
+                 compute_dtype: Optional[Any] = None):
         if row_slice is not None:
             raise NotImplementedError("Row slicing embedding is not supported yet!")
         self.world_size = int(world_size)
         self.axis_name = axis_name
         self.dp_input = dp_input
+        self.compute_dtype = compute_dtype
         self.strategy = DistEmbeddingStrategy(
             embeddings, self.world_size, strategy=strategy,
             input_table_map=input_table_map,
@@ -387,6 +396,9 @@ class DistributedEmbedding:
             # reference parity: a 1-D no-combiner input yields [batch, width]
             outs = [o[:, 0, :] if (sq and o.ndim == 3 and o.shape[1] == 1)
                     else o for o, sq in zip(outs, was_1d)]
+            if self.compute_dtype is not None:
+                # single-worker cast (reference dist_model_parallel.py:499)
+                outs = [o.astype(self.compute_dtype) for o in outs]
             return outs, ("local", inputs)
 
         world = self.world_size
@@ -453,9 +465,11 @@ class DistributedEmbedding:
                 parsed.append(seg.reshape(world * b, hots[i]))
                 pos += b * hots[i]
             outs = self._lookup_local(params_, rank, parsed, flatten_2d=True)
-            dt = next(iter(params_.values())).dtype
+            dt = self.compute_dtype or next(iter(params_.values())).dtype
             if outs:
-                cat = jnp.concatenate(outs, axis=1)
+                # pre-comm mixed-precision cast (reference :300): lookups and
+                # combiners ran in param dtype; the exchange rides compute_dtype
+                cat = jnp.concatenate(outs, axis=1).astype(dt)
             else:
                 # keep branch output types identical across ranks: match the
                 # param dtype and mark the constant device-varying

@@ -320,6 +320,44 @@ def test_mp_input_sgd_step_matches_reference(mesh):
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("dp_input", [True, False])
+def test_bf16_compute_dtype_forward(mesh, dp_input):
+    """compute_dtype=bf16: outputs come back bf16 (cast before the mp→dp
+    exchange, reference ``dist_model_parallel.py:300``) and match the fp32
+    oracle within bf16 tolerance."""
+    rng = np.random.default_rng(31)
+    configs, input_table_map = random_model(rng, num_tables=10)
+    de = DistributedEmbedding(configs, world_size=WORLD,
+                              strategy="memory_balanced",
+                              input_table_map=input_table_map,
+                              dp_input=dp_input,
+                              compute_dtype=jnp.bfloat16)
+    flat = de.init(jax.random.key(0), mesh=mesh)
+    tables = de.get_weights(flat)
+    inputs = make_inputs(rng, configs, input_table_map, global_batch=WORLD * 4)
+    expect = reference_forward(tables, configs, input_table_map, inputs)
+
+    if dp_input:
+        outs = dist_forward_fn(de, mesh, len(inputs))(flat, *inputs)
+    else:
+        outs = dist_forward_mp_fn(de, mesh)(flat,
+                                            de.pack_mp_inputs(inputs,
+                                                              mesh=mesh))
+    for o, e in zip(outs, expect):
+        assert o.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(o, np.float32), np.asarray(e),
+                                   rtol=2e-2, atol=2e-2)
+
+
+def test_bf16_single_worker_cast():
+    configs = [{"input_dim": 10, "output_dim": 4, "combiner": "sum"}]
+    de = DistributedEmbedding(configs, world_size=1,
+                              compute_dtype=jnp.bfloat16)
+    flat = de.init(jax.random.key(0))
+    outs = de(flat, [jnp.asarray([[1, 2], [3, 4]], jnp.int32)])
+    assert outs[0].dtype == jnp.bfloat16
+
+
 def test_world_size_one_passthrough():
     configs = [{"input_dim": 10, "output_dim": 4, "combiner": "sum"},
                {"input_dim": 8, "output_dim": 2, "combiner": None}]
