@@ -15,7 +15,7 @@ bench:
 	python bench.py
 
 pkg:
-	python setup.py bdist_wheel
+	python -m build --wheel 2>/dev/null || pip wheel --no-deps -w dist .
 
 clean:
 	$(MAKE) -C cc clean

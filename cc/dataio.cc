@@ -96,6 +96,16 @@ struct CriteoReader {
   int numerical_fd = -1;
   int num_numerical = 0;
   int64_t num_samples = 0;
+  // Closes all fds opened so far, so `delete r` on partial-open error paths
+  // cannot leak descriptors (repeated open failures would exhaust the fd
+  // table otherwise).
+  ~CriteoReader() {
+    if (label_fd >= 0) close(label_fd);
+    if (numerical_fd >= 0) close(numerical_fd);
+    for (auto& f : cats) {
+      if (f.fd >= 0) close(f.fd);
+    }
+  }
 };
 
 static int cat_elem_size(int64_t vocab) {
@@ -216,11 +226,7 @@ int detpu_criteo_read_batch(void* handle, int64_t start, int64_t batch,
 }
 
 void detpu_criteo_close(void* handle) {
-  CriteoReader* r = (CriteoReader*)handle;
-  if (r->label_fd >= 0) close(r->label_fd);
-  if (r->numerical_fd >= 0) close(r->numerical_fd);
-  for (auto& f : r->cats) close(f.fd);
-  delete r;
+  delete (CriteoReader*)handle;  // destructor closes all fds
 }
 
 }  // extern "C"

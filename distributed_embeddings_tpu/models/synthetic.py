@@ -124,8 +124,8 @@ class InputGenerator:
                  row_cap: Optional[int] = None):
         from ..utils.data import power_law_ids
         rng = np.random.default_rng(seed)
-        _, input_table_map, hotness = expand_embedding_configs(model_config)
-        table_configs, _, _ = expand_embedding_configs(model_config)
+        table_configs, input_table_map, hotness = expand_embedding_configs(
+            model_config)
         self.batches = []
         for _ in range(num_batches):
             cats = []

@@ -67,12 +67,14 @@ class SparseAdagrad:
         uids, uvals = dedup_sparse_grad(ids, vals, pad_id=slab.shape[0])
         acc_rows = jnp.take(accum, uids, axis=0, mode="clip")
         new_acc = acc_rows + uvals * uvals
+        # uids are sorted but NOT formally unique: the dedup tail repeats the
+        # pad sentinel (slab row capacity). unique_indices=True would violate
+        # XLA's contract (implementation-defined); sorted + mode='drop' keeps
+        # the fast path and drops every sentinel copy out of bounds.
         accum = accum.at[uids].set(new_acc, mode="drop",
-                                   indices_are_sorted=True,
-                                   unique_indices=True)
+                                   indices_are_sorted=True)
         # optax scale_by_rss semantics: g * rsqrt(acc_new + eps)
         update = lr * uvals * lax.rsqrt(new_acc + self.eps)
         slab = slab.at[uids].add(-update, mode="drop",
-                                 indices_are_sorted=True,
-                                 unique_indices=True)
+                                 indices_are_sorted=True)
         return slab, accum

@@ -51,12 +51,18 @@ class DummyDataset:
 
     def __init__(self, batch_size: int, num_numerical_features: int,
                  table_sizes: Sequence[int], num_batches: int,
-                 hotness: int = 1, num_workers: int = 1):
+                 hotness=1, num_workers: int = 1):
         local_bs = batch_size // num_workers
         self.numerical = np.zeros((local_bs, num_numerical_features),
                                   np.float32)
-        self.categorical = [np.zeros((local_bs, hotness), np.int32)
-                            for _ in table_sizes]
+        # hotness: one int for all tables, or a per-table sequence (the
+        # reference's DummyDataset takes per-feature hotness, utils.py:126-154)
+        if isinstance(hotness, (int, np.integer)):
+            hotness = [int(hotness)] * len(table_sizes)
+        if len(hotness) != len(table_sizes):
+            raise ValueError("hotness list must match table_sizes")
+        self.categorical = [np.zeros((local_bs, h), np.int32)
+                            for h in hotness]
         self.labels = np.ones((local_bs, 1), np.float32)
         self.num_batches = num_batches
 
@@ -138,8 +144,6 @@ class RawBinaryDataset:
             self._cat_maps.append(m)
 
         self._prefetch_depth = min(prefetch_depth, self._num_entries)
-        self._queue: "queue.Queue" = queue.Queue()
-        self._thread = None
 
     def __len__(self):
         return self._num_entries
@@ -171,15 +175,36 @@ class RawBinaryDataset:
                 yield self._read(i)
             return
 
+        # Fresh bounded queue + thread per iteration: maxsize caps read-ahead
+        # memory at prefetch_depth batches, and an abandoned iteration can't
+        # leak stale batches into the next epoch. The stop event makes the
+        # producer exit promptly when the consumer abandons the generator —
+        # a thread blocked forever on put() would keep the queue and memmaps
+        # alive for the process lifetime.
+        q: "queue.Queue" = queue.Queue(maxsize=self._prefetch_depth)
+        stop = threading.Event()
+
+        def put_until_stopped(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
         def producer():
             for i in range(self._num_entries):
-                self._queue.put(self._read(i))
-            self._queue.put(None)
+                if not put_until_stopped(self._read(i)):
+                    return
+            put_until_stopped(None)
 
-        self._thread = threading.Thread(target=producer, daemon=True)
-        self._thread.start()
-        while True:
-            item = self._queue.get()
-            if item is None:
-                return
-            yield item
+        threading.Thread(target=producer, daemon=True).start()
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                yield item
+        finally:
+            stop.set()
