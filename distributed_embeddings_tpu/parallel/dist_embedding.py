@@ -49,6 +49,19 @@ from .strategy import DistEmbeddingStrategy
 
 EmbedParams = Dict[str, jax.Array]
 
+# Checkpoint streaming chunk: 128M elements, the reference's scatter-update
+# chunk size (``dist_model_parallel.py:362-380``); also keeps every single
+# host<->device transfer below the 2^31-element indexing cliff the reference
+# engineered around (``:388-409,426-438``).
+CHECKPOINT_CHUNK_ELEMS = 128 * 1024 * 1024
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def _write_rows(buf: jax.Array, chunk: jax.Array, start) -> jax.Array:
+    """Donated row-range write into a shard buffer (in-place on backends with
+    donation; at worst one transient shard copy)."""
+    return lax.dynamic_update_slice(buf, chunk, (start, 0))
+
 
 @struct.dataclass
 class MpInputs:
@@ -203,24 +216,51 @@ class DistributedEmbedding:
     def init(self, key, dtype=jnp.float32, mesh=None) -> EmbedParams:
         """Build the global param dict ``{width: [world, rows_cap, width]}``.
 
-        With ``mesh`` given, slabs are laid out sharded over ``(axis_name,)``
-        so each rank's rows materialize on its own device.
+        With ``mesh`` given, each device's shard is initialized by its own
+        small program and assembled with
+        ``jax.make_array_from_single_device_arrays`` — no single jit ever
+        materializes more than one rank's slab (the reference forces huge
+        inits off-accelerator for the same reason, ``embedding.py:28-38``),
+        and on multi-host meshes each process initializes only its
+        addressable shards.
         """
         keys = jax.random.split(key, self.world_size)
 
-        def build():
-            out = {}
-            for w in self.widths:
-                out[_wkey(w)] = jnp.stack([
-                    self._init_rank_width(keys[r], r, w, dtype)
-                    for r in range(self.world_size)])
-            return out
-
         if mesh is None:
+            def build():
+                out = {}
+                for w in self.widths:
+                    out[_wkey(w)] = jnp.stack([
+                        self._init_rank_width(keys[r], r, w, dtype)
+                        for r in range(self.world_size)])
+                return out
             return jax.jit(build)()
+
         sharding = jax.sharding.NamedSharding(
             mesh, jax.sharding.PartitionSpec(self.axis_name))
-        return jax.jit(build, out_shardings=sharding)()
+        out = {}
+        for w in self.widths:
+            shape = (self.world_size, self.rows_cap[w], w)
+            arrays = []
+            for dev, idx in sharding.devices_indices_map(shape).items():
+                if dev.process_index != jax.process_index():
+                    continue
+                r0, r1, _ = idx[0].indices(self.world_size)
+
+                def build_shard(ks, r0=r0, r1=r1, w=w):
+                    return jnp.stack([
+                        self._init_rank_width(ks[r], r, w, dtype)
+                        for r in range(r0, r1)])
+
+                with jax.default_device(dev):
+                    shard = jax.jit(build_shard)(keys)
+                # default_device does not bind committed inputs (a committed
+                # PRNG key would drag every shard to its own device); commit
+                # the result explicitly (no-copy when already on dev)
+                arrays.append(jax.device_put(shard, dev))
+            out[_wkey(w)] = jax.make_array_from_single_device_arrays(
+                shape, sharding, arrays)
+        return out
 
     def local_view(self, params: EmbedParams) -> EmbedParams:
         """Squeeze the leading world axis of per-device slabs
@@ -669,54 +709,184 @@ class DistributedEmbedding:
 
     # ------------------------------------------------------------- checkpoint
 
-    def get_weights(self, params: EmbedParams) -> List[np.ndarray]:
-        """Reassemble the full (unsliced) global tables on host.
+    def _slice_plan(self):
+        """Per-(rank, local table) checkpoint routing:
+        ``plan[rank][m] = (table_id, row_offset, rows, col_start, width)``
+        where ``col_start`` is the slice's first column in the full (unsliced)
+        source table — column slices are consumed in rank order, the
+        reference's ``_slice_weight_for_rank`` math
+        (``dist_model_parallel.py:346-361``)."""
+        col_pos = {tid: 0 for tid in range(len(self.strategy.global_configs))}
+        plan: List[List[tuple]] = []
+        for r, cfgs in enumerate(self.strategy.local_configs_list):
+            rank_plan = []
+            for m, cfg in enumerate(cfgs):
+                _, roff, rows, w = self._table_rows(r, m)
+                tid = self.strategy.table_ids_list[r][m]
+                rank_plan.append((tid, roff, rows, col_pos[tid], w))
+                col_pos[tid] += w
+            plan.append(rank_plan)
+        return plan
+
+    def _fetch_rows(self, v, rank: int, start: int, n: int) -> np.ndarray:
+        """Host copy of ``v[rank, start:start+n, :]`` without materializing
+        anything bigger. For non-addressable shards (multi-host) the slice is
+        jit-extracted with a fully-replicated out-sharding — the chunked
+        allgather of the reference's ``get_weights``
+        (``dist_model_parallel.py:441-447``) — so every process gets it."""
+        if isinstance(v, np.ndarray):
+            return np.asarray(v[rank, start:start + n, :])
+        w = v.shape[2]
+        if v.is_fully_addressable:
+            # Slice on the owning shard's device — a single-device program
+            # that transfers only the chunk (a dynamic_slice on the *global*
+            # array would make GSPMD materialize a full replica per call).
+            for shard in v.addressable_shards:
+                r0, r1, _ = shard.index[0].indices(v.shape[0])
+                if not (r0 <= rank < r1):
+                    continue
+                key = ("fetch_shard", shard.data.shape, v.dtype, n)
+                fn = self._ckpt_jit_cache.get(key)
+                if fn is None:
+                    fn = jax.jit(lambda a, r, s: lax.dynamic_slice(
+                        a, (r, s, 0), (1, n, w))[0])
+                    self._ckpt_jit_cache[key] = fn
+                return np.asarray(fn(shard.data, rank - r0, start))
+            raise AssertionError("fully-addressable array with no owner shard")
+        # Multi-host: every process needs the chunk but no process holds all
+        # shards. A masked psum inside shard_map moves exactly one chunk over
+        # the network — the reference's chunked allgather
+        # (``dist_model_parallel.py:441-447``) — never a full replica.
+        mesh = v.sharding.mesh
+        axis = self.axis_name
+        key = ("fetch_global", v.shape, v.dtype, n, id(mesh))
+        fn = self._ckpt_jit_cache.get(key)
+        if fn is None:
+            P = jax.sharding.PartitionSpec
+            blk = v.shape[0] // mesh.shape[axis]
+
+            def local(ab, r, s):
+                my = lax.axis_index(axis)
+                rel = r - my * blk
+                hit = (rel >= 0) & (rel < blk)
+                rows = lax.dynamic_slice(
+                    ab, (jnp.clip(rel, 0, blk - 1), s, 0), (1, n, w))[0]
+                return lax.psum(jnp.where(hit, rows, 0), axis)
+
+            fn = jax.jit(jax.shard_map(
+                local, mesh=mesh, in_specs=(P(axis), P(), P()),
+                out_specs=P()))
+            self._ckpt_jit_cache[key] = fn
+        return np.asarray(fn(v, jnp.asarray(rank), jnp.asarray(start)))
+
+    def get_weights(self, params: EmbedParams,
+                    chunk_elems: int = CHECKPOINT_CHUNK_ELEMS
+                    ) -> List[np.ndarray]:
+        """Reassemble the full (unsliced) global tables on host, streaming
+        row chunks of at most ``chunk_elems`` elements.
 
         Equivalent of the reference's chunked-allgather ``get_weights``
-        (``dist_model_parallel.py:411-485``); on a single host the sharded
-        slabs are addressable, so this is per-rank parse + slice concat.
-        """
-        host = {k: np.asarray(jax.device_get(v)) for k, v in params.items()}
-        host = {k: (v[None] if v.ndim == 2 else v) for k, v in host.items()}
-        per_table: dict = {}
-        for r, cfgs in enumerate(self.strategy.local_configs_list):
-            for m, cfg in enumerate(cfgs):
-                k, roff, rows, w = self._table_rows(r, m)
-                tid = self.strategy.table_ids_list[r][m]
-                per_table.setdefault(tid, []).append(
-                    host[k][r, roff:roff + rows, :])
-        return [np.concatenate(per_table[tid], axis=1)
-                if len(per_table[tid]) > 1 else per_table[tid][0]
-                for tid in range(len(self.strategy.global_configs))]
+        (``dist_model_parallel.py:411-485``): peak transient host memory is
+        one chunk, not one model; tables over 2^31 elements stream fine; on
+        multi-host meshes every process receives the full tables (the
+        reference's ``all_ranks=True``)."""
+        if not hasattr(self, "_ckpt_jit_cache"):
+            self._ckpt_jit_cache = {}
+        params = self.stacked_view(params)
+        out: List[Optional[np.ndarray]] = (
+            [None] * len(self.strategy.global_configs))
+        for r, rank_plan in enumerate(self._slice_plan()):
+            for tid, roff, rows, c0, w in rank_plan:
+                v = params[_wkey(w)]
+                if out[tid] is None:
+                    full_w = int(
+                        self.strategy.global_configs[tid]["output_dim"])
+                    out[tid] = np.empty((rows, full_w), v.dtype)
+                chunk_rows = max(1, int(chunk_elems) // max(w, 1))
+                for s in range(0, rows, chunk_rows):
+                    n = min(chunk_rows, rows - s)
+                    out[tid][s:s + n, c0:c0 + w] = self._fetch_rows(
+                        v, r, roff + s, n)
+        return out
 
-    def set_weights(self, weights: Sequence[Any], mesh=None,
-                    dtype=jnp.float32) -> EmbedParams:
-        """Build the sharded slab dict from full global tables (numpy arrays
-        or ``np.load``-able paths, mmap'd like the reference,
-        ``dist_model_parallel.py:337-339``)."""
-        loaded = [np.load(w, mmap_mode="r") if isinstance(w, str) else w
-                  for w in weights]
-        if len(loaded) != len(self.strategy.global_configs):
-            raise ValueError("set_weights needs one array per global table")
-        # Column offset of each slice, consumed in rank order per table.
-        col_pos = {tid: 0 for tid in range(len(loaded))}
-        out = {w: np.zeros((self.world_size, self.rows_cap[w], w), np.float32)
-               for w in self.widths}
-        for r, cfgs in enumerate(self.strategy.local_configs_list):
-            for m, cfg in enumerate(cfgs):
-                k, roff, rows, w = self._table_rows(r, m)
-                tid = self.strategy.table_ids_list[r][m]
+    def _build_shard(self, loaded, dev, width: int, r0: int, r1: int,
+                     dtype, chunk_elems: int) -> jax.Array:
+        """Stream one device's slab shard ``[r1-r0, rows_cap, width]``:
+        zeros on-device, then donated row-range writes of at most
+        ``chunk_elems`` elements read straight from the (possibly mmap'd)
+        sources — never a host copy bigger than one chunk."""
+        with jax.default_device(dev):
+            buf = jnp.zeros((r1 - r0, self.rows_cap[width], width), dtype)
+        # commit to dev (no-copy) so later ops can't migrate an unwritten
+        # buffer back to the default device
+        buf = jax.device_put(buf, dev)
+        shape3 = buf.shape
+        buf = buf.reshape(-1, width)
+        plan = self._slice_plan()
+        chunk_rows = max(1, int(chunk_elems) // max(width, 1))
+        for r in range(r0, r1):
+            base = (r - r0) * self.rows_cap[width]
+            for tid, roff, rows, c0, w in plan[r]:
+                if w != width:
+                    continue
                 src = loaded[tid]
                 if src.shape[0] != rows:
                     raise ValueError(
                         f"Table {tid}: expected {rows} rows, got {src.shape[0]}")
-                start = col_pos[tid]
-                out[w][r, roff:roff + rows, :] = src[:, start:start + w]
-                col_pos[tid] = start + w
-        result = {_wkey(w): jnp.asarray(v, dtype) for w, v in out.items()}
-        if mesh is not None:
+                for s in range(0, rows, chunk_rows):
+                    n = min(chunk_rows, rows - s)
+                    host = np.ascontiguousarray(
+                        src[s:s + n, c0:c0 + w], dtype=dtype)
+                    buf = _write_rows(buf, jax.device_put(host, dev),
+                                      base + roff + s)
+        return buf.reshape(shape3)
+
+    def set_weights(self, weights: Sequence[Any], mesh=None,
+                    dtype=jnp.float32,
+                    chunk_elems: int = CHECKPOINT_CHUNK_ELEMS) -> EmbedParams:
+        """Build the sharded slab dict from full global tables (numpy arrays
+        or ``np.load``-able paths, mmap'd like the reference,
+        ``dist_model_parallel.py:337-339``).
+
+        Streams per-slice row chunks directly into per-device shard buffers
+        — the reference's 128M-element chunked ``scatter_update``
+        (``dist_model_parallel.py:362-380``) — so peak transient host memory
+        is one chunk regardless of model size, and >2^31-element tables never
+        hit a single oversized transfer. On multi-host meshes each process
+        builds only its addressable shards."""
+        loaded = [np.load(w, mmap_mode="r") if isinstance(w, str)
+                  else np.asarray(w) for w in weights]
+        if len(loaded) != len(self.strategy.global_configs):
+            raise ValueError("set_weights needs one array per global table")
+        for tid, (src, cfg) in enumerate(
+                zip(loaded, self.strategy.global_configs)):
+            want = (int(cfg["input_dim"]), int(cfg["output_dim"]))
+            if tuple(src.shape) != want:
+                # a narrower source would silently zero-fill under
+                # dynamic_update_slice — reject shape drift up front
+                raise ValueError(
+                    f"Table {tid}: expected shape {want}, got {src.shape}")
+        out = {}
+        for w in self.widths:
+            shape = (self.world_size, self.rows_cap[w], w)
+            if mesh is None:
+                # honor an active jax.default_device context (e.g. staging a
+                # bigger-than-HBM model on host), like the old asarray path
+                dev = jax.config.jax_default_device or jax.devices()[0]
+                if isinstance(dev, str):  # context also accepts platform names
+                    dev = jax.devices(dev)[0]
+                out[_wkey(w)] = self._build_shard(
+                    loaded, dev, w, 0, self.world_size, dtype, chunk_elems)
+                continue
             sharding = jax.sharding.NamedSharding(
                 mesh, jax.sharding.PartitionSpec(self.axis_name))
-            result = {k: jax.device_put(v, sharding)
-                      for k, v in result.items()}
-        return result
+            arrays = []
+            for dev, idx in sharding.devices_indices_map(shape).items():
+                if dev.process_index != jax.process_index():
+                    continue
+                r0, r1, _ = idx[0].indices(self.world_size)
+                arrays.append(self._build_shard(
+                    loaded, dev, w, r0, r1, dtype, chunk_elems))
+            out[_wkey(w)] = jax.make_array_from_single_device_arrays(
+                shape, sharding, arrays)
+        return out

@@ -194,10 +194,16 @@ class RawBinaryDataset:
             return False
 
         def producer():
-            for i in range(self._num_entries):
-                if not put_until_stopped(self._read(i)):
-                    return
-            put_until_stopped(None)
+            # An exception (truncated file, transient IO error) must reach
+            # the consumer — a silently dead producer would leave the
+            # consumer blocked on q.get() forever.
+            try:
+                for i in range(self._num_entries):
+                    if not put_until_stopped(self._read(i)):
+                        return
+                put_until_stopped(None)
+            except BaseException as e:  # noqa: BLE001 - relayed, not dropped
+                put_until_stopped(e)
 
         threading.Thread(target=producer, daemon=True).start()
         try:
@@ -205,6 +211,8 @@ class RawBinaryDataset:
                 item = q.get()
                 if item is None:
                     return
+                if isinstance(item, BaseException):
+                    raise item
                 yield item
         finally:
             stop.set()
