@@ -6,6 +6,7 @@ runtime communication (Horovod all-to-all/allreduce in the reference) becomes
 ``jax.lax`` collectives inside ``jax.shard_map`` over a named mesh axis.
 """
 
+from . import bootstrap
 from .strategy import DistEmbeddingStrategy
 from .dist_embedding import DistributedEmbedding, MpInputs
 from .grads import (

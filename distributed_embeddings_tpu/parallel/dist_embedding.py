@@ -236,31 +236,38 @@ class DistributedEmbedding:
                 return out
             return jax.jit(build)()
 
-        sharding = jax.sharding.NamedSharding(
-            mesh, jax.sharding.PartitionSpec(self.axis_name))
         out = {}
         for w in self.widths:
-            shape = (self.world_size, self.rows_cap[w], w)
-            arrays = []
-            for dev, idx in sharding.devices_indices_map(shape).items():
-                if dev.process_index != jax.process_index():
-                    continue
-                r0, r1, _ = idx[0].indices(self.world_size)
-
-                def build_shard(ks, r0=r0, r1=r1, w=w):
+            def init_shard(dev, r0, r1, w=w):
+                def build(ks):
                     return jnp.stack([
                         self._init_rank_width(ks[r], r, w, dtype)
                         for r in range(r0, r1)])
-
                 with jax.default_device(dev):
-                    shard = jax.jit(build_shard)(keys)
+                    shard = jax.jit(build)(keys)
                 # default_device does not bind committed inputs (a committed
                 # PRNG key would drag every shard to its own device); commit
                 # the result explicitly (no-copy when already on dev)
-                arrays.append(jax.device_put(shard, dev))
-            out[_wkey(w)] = jax.make_array_from_single_device_arrays(
-                shape, sharding, arrays)
+                return jax.device_put(shard, dev)
+
+            out[_wkey(w)] = self._assemble_sharded(mesh, w, init_shard)
         return out
+
+    def _assemble_sharded(self, mesh, width: int, build_shard) -> jax.Array:
+        """Assemble one width's global ``[world, rows_cap, w]`` slab from
+        per-device shards built by ``build_shard(dev, r0, r1)`` — only this
+        process's addressable shards are materialized (multi-host safe)."""
+        shape = (self.world_size, self.rows_cap[width], width)
+        sharding = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(self.axis_name))
+        arrays = []
+        for dev, idx in sharding.devices_indices_map(shape).items():
+            if dev.process_index != jax.process_index():
+                continue
+            r0, r1, _ = idx[0].indices(self.world_size)
+            arrays.append(build_shard(dev, r0, r1))
+        return jax.make_array_from_single_device_arrays(
+            shape, sharding, arrays)
 
     def local_view(self, params: EmbedParams) -> EmbedParams:
         """Squeeze the leading world axis of per-device slabs
@@ -379,11 +386,16 @@ class DistributedEmbedding:
             if blk.shape[1] < l_max:
                 blk = np.pad(blk, ((0, 0), (0, l_max - blk.shape[1])))
             rows.append(blk)
-        packed = jnp.asarray(np.stack(rows), dtype)  # [dest, src, l_max]
+        packed_np = np.stack(rows).astype(jnp.dtype(dtype))  # [dest, src, l_max]
         if mesh is not None:
             sharding = jax.sharding.NamedSharding(
                 mesh, jax.sharding.PartitionSpec(self.axis_name))
-            packed = jax.device_put(packed, sharding)
+            # callback-per-shard works on multi-host meshes too: each process
+            # materializes only its addressable blocks
+            packed = jax.make_array_from_callback(
+                packed_np.shape, sharding, lambda idx: packed_np[idx])
+        else:
+            packed = jnp.asarray(packed_np)
         return MpInputs(packed=packed, hots=hots, local_batch=b)
 
     def _lookup_local(self, params: EmbedParams, rank: int,
@@ -868,7 +880,6 @@ class DistributedEmbedding:
                     f"Table {tid}: expected shape {want}, got {src.shape}")
         out = {}
         for w in self.widths:
-            shape = (self.world_size, self.rows_cap[w], w)
             if mesh is None:
                 # honor an active jax.default_device context (e.g. staging a
                 # bigger-than-HBM model on host), like the old asarray path
@@ -878,15 +889,8 @@ class DistributedEmbedding:
                 out[_wkey(w)] = self._build_shard(
                     loaded, dev, w, 0, self.world_size, dtype, chunk_elems)
                 continue
-            sharding = jax.sharding.NamedSharding(
-                mesh, jax.sharding.PartitionSpec(self.axis_name))
-            arrays = []
-            for dev, idx in sharding.devices_indices_map(shape).items():
-                if dev.process_index != jax.process_index():
-                    continue
-                r0, r1, _ = idx[0].indices(self.world_size)
-                arrays.append(self._build_shard(
+            out[_wkey(w)] = self._assemble_sharded(
+                mesh, w,
+                lambda dev, r0, r1, w=w: self._build_shard(
                     loaded, dev, w, r0, r1, dtype, chunk_elems))
-            out[_wkey(w)] = jax.make_array_from_single_device_arrays(
-                shape, sharding, arrays)
         return out

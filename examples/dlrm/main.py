@@ -27,8 +27,8 @@ from distributed_embeddings_tpu.models.dlrm import (
 from distributed_embeddings_tpu.models.schedules import (
     warmup_poly_decay_schedule)
 from distributed_embeddings_tpu.parallel import (
-    DistributedEmbedding, SparseSGD, init_hybrid_state, make_hybrid_eval_step,
-    make_hybrid_train_step)
+    DistributedEmbedding, SparseSGD, bootstrap, init_hybrid_state,
+    make_hybrid_eval_step, make_hybrid_train_step)
 from distributed_embeddings_tpu.utils import (
     RawBinaryDataset, binary_auc, power_law_ids)
 
@@ -73,6 +73,11 @@ def synthetic_batches(cfg, num_batches, batch_size, seed=0):
 
 
 def main(_):
+    # multi-host bootstrap (the reference's hvd.init, main.py:152-157 there):
+    # no-op on a single host; on a pod every host runs this same script
+    bootstrap.initialize()
+    is_chief = bootstrap.process_index() == 0
+
     table_sizes = [int(s) for s in FLAGS.table_sizes]
     if FLAGS.dataset_path is not None:
         with open(os.path.join(FLAGS.dataset_path, "model_size.json"),
@@ -98,7 +103,8 @@ def main(_):
                               dp_input=not use_mp_input,
                               column_slice_threshold=FLAGS.column_slice_threshold)
     dense = DLRMDense(cfg)
-    print(de.strategy.describe())
+    if is_chief:
+        print(de.strategy.describe())
 
     dense_params = dense.init(
         jax.random.key(0),
@@ -123,11 +129,32 @@ def main(_):
     step_fn = make_hybrid_train_step(de, loss_fn, tx, emb_opt, mesh=mesh,
                                      lr_schedule=sched)
 
+    nproc = bootstrap.process_count()
+    pid = bootstrap.process_index()
+
     def prep_cats(cats):
         """Global per-feature id arrays -> the executor's input format."""
         if use_mp_input:
+            # multi-host correct: each process materializes only its blocks
             return de.pack_mp_inputs(cats, mesh=mesh)
+        if nproc > 1:
+            # dp input on a pod: every process holds the same global batch
+            # (synthetic: seeded identically; Criteo: full-file readers) and
+            # contributes its rows of it
+            def local_rows(c):
+                c = np.asarray(c)
+                return c[(len(c) // nproc) * pid:(len(c) // nproc) * (pid + 1)]
+            return [bootstrap.shard_batch(mesh, local_rows(c)) for c in cats]
         return [jnp.asarray(c) for c in cats]
+
+    def prep_batch(num, labels):
+        """Dense features/labels -> per-device data-parallel shards."""
+        if nproc > 1:
+            lb = num.shape[0] // nproc
+            return bootstrap.shard_batch(
+                mesh, (np.asarray(num)[lb * pid:lb * (pid + 1)],
+                       np.asarray(labels)[lb * pid:lb * (pid + 1)]))
+        return jnp.asarray(num), jnp.asarray(labels)
 
     if FLAGS.dataset_path is not None:
         # mp input reads full global batches per feature and packs them
@@ -154,8 +181,8 @@ def main(_):
         eval_data = None
 
     for step, (num, cats, labels) in enumerate(train_iter):
-        loss, state = step_fn(state, prep_cats(cats), (num, labels))
-        if step % 1000 == 0:
+        loss, state = step_fn(state, prep_cats(cats), prep_batch(num, labels))
+        if step % 1000 == 0 and is_chief:
             print("step:", step, " loss:", float(loss))
 
     if eval_data is not None:
@@ -164,16 +191,23 @@ def main(_):
             mesh=mesh)
         all_preds, all_labels = [], []
         for num, cats, labels in eval_data:
-            preds = eval_fn(state, prep_cats(cats), jnp.asarray(num))
-            all_preds.append(np.asarray(preds))
+            num_in = prep_batch(num, labels)[0] if nproc > 1 else jnp.asarray(num)
+            preds = eval_fn(state, prep_cats(cats), num_in)
+            # process-spanning predictions gather to every host (the
+            # reference's hvd.allgather eval, main.py:230-243 there)
+            all_preds.append(bootstrap.to_host(preds))
             all_labels.append(np.asarray(labels))
         auc = binary_auc(np.concatenate(all_labels),
                          np.concatenate(all_preds))
-        print(f"Evaluation completed, AUC: {auc}")
+        if is_chief:
+            print(f"Evaluation completed, AUC: {auc}")
 
+    # every process participates in the chunked gather; rank 0 writes
+    # (reference main.py:246-248 there)
     weights = de.get_weights(state.emb_params)
-    np.savez(FLAGS.checkpoint_out, *weights)
-    print("saved", len(weights), "tables to", FLAGS.checkpoint_out)
+    if is_chief:
+        np.savez(FLAGS.checkpoint_out, *weights)
+        print("saved", len(weights), "tables to", FLAGS.checkpoint_out)
 
 
 if __name__ == "__main__":
