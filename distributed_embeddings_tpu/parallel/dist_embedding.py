@@ -27,9 +27,13 @@ JAX SPMD instead of Horovod MPMD:
   Autodiff of ``all_to_all`` provides the backward exchange exactly like
   Horovod's registered alltoall gradient.
 
-Input contract (distributed path): dense int arrays, ``[local_batch]`` or
-``[local_batch, hotness]`` per feature, identical batch on every rank —
-matching the reference's dense-only ``_call_base`` (``:261-311``).
+Input contract (distributed path): per feature either a dense int array
+(``[local_batch]`` or ``[local_batch, hotness]``) or a static-capacity
+:class:`~..ops.embedding_lookup.Ragged` (values ``[cap]``, row_splits
+``[local_batch+1]``; combiner required), identical batch and capacities on
+every rank. Ragged features travel inside the padded id all-to-all as
+``[values(cap), lengths(b)]`` blocks — the variable-hotness capability the
+reference reaches through its custom kernel (``embedding_lookup_ops.py:79-80``).
 """
 
 from __future__ import annotations
@@ -44,7 +48,7 @@ from flax import struct
 from jax import lax
 
 from ..layers.embedding import default_embeddings_init
-from ..ops.embedding_lookup import embedding_lookup
+from ..ops.embedding_lookup import Ragged, embedding_lookup, ragged_row_ids
 from .strategy import DistEmbeddingStrategy
 
 EmbedParams = Dict[str, jax.Array]
@@ -89,12 +93,23 @@ class MpInputs:
     local_batch: int = struct.field(pytree_node=False)
 
 
-def _out_width(config, hotness: int) -> int:
+def _out_width(config, enc) -> int:
     """Per-input 2-D output width: combiner reduces hotness; no combiner
     flattens it (the reference reshapes every mp output to [batch, -1],
-    ``dist_model_parallel.py:297,307``)."""
+    ``dist_model_parallel.py:297,307``). ``enc`` is the input's routing
+    descriptor: ``("d", hotness)`` for dense, ``("r", capacity)`` for
+    static-capacity ragged (always combined, so width is the table width)."""
     w = int(config["output_dim"])
-    return w if config.get("combiner") else w * hotness
+    if enc[0] == "r":
+        return w
+    return w if config.get("combiner") else w * enc[1]
+
+
+def _block_len(enc, b: int) -> int:
+    """Ints a routed input contributes to one all-to-all block: a dense
+    ``[b, h]`` flattens to ``b*h``; a ragged feature travels as its padded
+    values plus per-row lengths, ``cap + b``."""
+    return enc[1] * b if enc[0] == "d" else enc[1] + b
 
 
 def _wkey(width: int) -> str:
@@ -291,22 +306,79 @@ class DistributedEmbedding:
     # ----------------------------------------------------------------- forward
 
     def _normalize_inputs(self, inputs):
-        """Promote to a common int dtype and 2-D ``[batch, hotness]``; track
-        which inputs were 1-D so local lookups can preserve the reference's
-        1-D output shape (``[batch, width]``, not ``[batch, 1, width]``)."""
+        """Promote to a common int dtype; dense inputs become 2-D
+        ``[batch, hotness]``, :class:`~..ops.embedding_lookup.Ragged` inputs
+        become ``("r", values [cap], lengths [batch])`` records. Returns
+        ``(entries, encs, was_1d)`` where ``encs[i]`` is the static routing
+        descriptor (see :func:`_out_width`) and ``was_1d`` tracks 1-D dense
+        inputs so local lookups preserve the reference's ``[batch, width]``
+        output shape."""
         if len(inputs) != self.strategy.num_inputs:
             raise ValueError(
                 f"Expected {self.strategy.num_inputs} inputs, got {len(inputs)}")
         comm_dtype = jnp.int32
         for inp in inputs:
-            if jnp.asarray(inp).dtype == jnp.int64:
+            arrs = ((inp.values, inp.row_splits) if isinstance(inp, Ragged)
+                    else (inp,))
+            if any(jnp.asarray(a).dtype == jnp.int64 for a in arrs):
                 comm_dtype = jnp.int64
-        out, was_1d = [], []
-        for inp in inputs:
-            inp = jnp.asarray(inp).astype(comm_dtype)
-            was_1d.append(inp.ndim == 1)
-            out.append(inp[:, None] if inp.ndim == 1 else inp)
-        return out, was_1d
+        out, encs, was_1d = [], [], []
+        for i, inp in enumerate(inputs):
+            if isinstance(inp, Ragged):
+                tid = self.strategy.input_table_map[i]
+                if not self.strategy.global_configs[tid].get("combiner"):
+                    raise ValueError(
+                        f"Ragged input {i} requires its table to have a "
+                        "combiner (reference routes multi-hot ragged through "
+                        "the combining kernel, embedding_lookup_ops.py:79-80)")
+                values = jnp.asarray(inp.values).astype(comm_dtype)
+                splits = jnp.asarray(inp.row_splits)
+                lengths = (splits[1:] - splits[:-1]).astype(comm_dtype)
+                out.append(("r", values, lengths))
+                encs.append(("r", int(values.shape[0])))
+                was_1d.append(False)
+            else:
+                inp = jnp.asarray(inp).astype(comm_dtype)
+                was_1d.append(inp.ndim == 1)
+                inp = inp[:, None] if inp.ndim == 1 else inp
+                out.append(inp)
+                encs.append(("d", int(inp.shape[1])))
+        return out, encs, was_1d
+
+    @staticmethod
+    def _ragged_segments(cap: int, lengths):
+        """Per-value segment ids for a ``[S, cap]`` block of per-source CSR
+        values: ``(gseg [S*cap], valid [S*cap])`` with padding positions
+        routed to the dropped sentinel segment ``S*b``. The
+        ``RowToSplit``/``OffsetToWeightsAndRowId`` pair of the reference
+        (``embedding_lookup_kernels.cu:331-361``), vectorized."""
+        S, b = lengths.shape
+        splits = jnp.concatenate(
+            [jnp.zeros((S, 1), lengths.dtype), jnp.cumsum(lengths, axis=1)],
+            axis=1)  # [S, b+1]
+        pos = jnp.arange(cap, dtype=splits.dtype)
+        seg = jax.vmap(lambda sp: ragged_row_ids(sp, cap))(splits)
+        valid = (pos[None, :] < splits[:, -1:]) & (seg < b)
+        src = jnp.arange(S, dtype=seg.dtype)[:, None]
+        gseg = jnp.where(valid, src * b + seg, S * b).reshape(-1)
+        return gseg, valid.reshape(-1)
+
+    def _ragged_block_combine(self, slab, roff, rows, values, lengths,
+                              combiner):
+        """Fused lookup+combine for a routed ragged feature: ``values
+        [S, cap]`` / ``lengths [S, b]`` hold one static-capacity CSR block
+        per source shard; output is ``[S*b, width]``."""
+        S, cap = values.shape
+        b = lengths.shape[1]
+        gseg, _ = self._ragged_segments(cap, lengths)
+        ids = (jnp.clip(values, 0, rows - 1) + roff).reshape(-1)
+        gathered = jnp.take(slab, ids, axis=0, mode="clip")
+        out = jnp.zeros((S * b + 1, slab.shape[1]), gathered.dtype)
+        out = out.at[gseg].add(gathered, mode="drop")[:S * b]
+        if combiner == "mean":
+            counts = jnp.maximum(lengths.reshape(-1), 1).astype(out.dtype)
+            out = out / counts[:, None]
+        return out
 
     def pack_mp_inputs(self, inputs, dtype=None, mesh=None,
                        hots: Optional[Sequence[int]] = None,
@@ -333,6 +405,10 @@ class DistributedEmbedding:
             path (int64 if any provided array is int64, else int32).
         """
         world = self.world_size
+        if any(isinstance(x, Ragged) for x in inputs):
+            raise NotImplementedError(
+                "pack_mp_inputs takes dense ids; ragged features currently "
+                "route through the dp-input path")
         arrs = [None if x is None else np.asarray(x) for x in inputs]
         if len(arrs) != self.strategy.num_inputs:
             raise ValueError(
@@ -411,6 +487,14 @@ class DistributedEmbedding:
             cfg = self.strategy.local_configs_list[rank][m]
             k, roff, rows, w = self._table_rows(rank, m)
             slab = params[k]
+            if isinstance(inp, tuple) and inp[0] == "r":
+                _, values, lengths = inp
+                if values.ndim == 1:
+                    values, lengths = values[None], lengths[None]
+                o = self._ragged_block_combine(
+                    slab, roff, rows, values, lengths, cfg.get("combiner"))
+                outs.append(o)
+                continue
             shifted = jnp.clip(inp, 0, rows - 1) + roff
             o = embedding_lookup(slab, shifted, combiner=cfg.get("combiner"))
             outs.append(o.reshape(o.shape[0], -1) if flatten_2d else o)
@@ -443,7 +527,7 @@ class DistributedEmbedding:
                 raise ValueError(
                     "world_size == 1 takes a plain input list (mp and dp "
                     "input coincide)")
-            inputs, was_1d = self._normalize_inputs(inputs)
+            inputs, _, was_1d = self._normalize_inputs(inputs)
             outs = self._lookup_local(params, 0, inputs, flatten_2d=False)
             # reference parity: a 1-D no-combiner input yields [batch, width]
             outs = [o[:, 0, :] if (sq and o.ndim == 3 and o.shape[1] == 1)
@@ -455,26 +539,36 @@ class DistributedEmbedding:
 
         world = self.world_size
         if self.dp_input:
-            inputs, _ = self._normalize_inputs(inputs)
-            b = inputs[0].shape[0]
-            for inp in inputs:
-                if inp.shape[0] != b:
+            entries, encs, _ = self._normalize_inputs(inputs)
+
+            def batch_of(e):
+                return e[2].shape[0] if isinstance(e, tuple) else e.shape[0]
+
+            b = batch_of(entries[0])
+            for e in entries:
+                if batch_of(e) != b:
                     raise ValueError("All inputs must share the batch dimension")
-            hots = [int(inp.shape[1]) for inp in inputs]
-            comm_dtype = inputs[0].dtype
+            comm_dtype = (entries[0][1].dtype if isinstance(entries[0], tuple)
+                          else entries[0].dtype)
 
             # --- dp -> mp id exchange --------------------------------------
             # Block for dest rank r: its inputs flattened and concatenated
-            # (reference :273-282), padded to the max block length.
-            block_lens = [b * sum(hots[i] for i in ids)
+            # (reference :273-282), padded to the max block length. Ragged
+            # features contribute [values(cap), lengths(b)].
+            block_lens = [sum(_block_len(encs[i], b) for i in ids)
                           for ids in self.strategy.input_ids_list]
             l_max = max(max(block_lens), 1)
             blocks = []
             for ids in self.strategy.input_ids_list:
-                if ids:
-                    blk = jnp.concatenate([inputs[i].reshape(-1) for i in ids])
-                else:
-                    blk = jnp.zeros((0,), comm_dtype)
+                parts = []
+                for i in ids:
+                    e = entries[i]
+                    if isinstance(e, tuple):
+                        parts.extend([e[1], e[2]])
+                    else:
+                        parts.append(e.reshape(-1))
+                blk = (jnp.concatenate(parts) if parts
+                       else jnp.zeros((0,), comm_dtype))
                 if blk.shape[0] < l_max:
                     blk = jnp.concatenate(
                         [blk, jnp.zeros((l_max - blk.shape[0],), comm_dtype)])
@@ -493,7 +587,7 @@ class DistributedEmbedding:
                 raise ValueError(
                     f"Expected {self.strategy.num_inputs} hotness entries, "
                     f"got {len(inputs.hots)}")
-            hots = [int(h) for h in inputs.hots]
+            encs = [("d", int(h)) for h in inputs.hots]
             b = int(inputs.local_batch)
             ids_recv = inputs.packed
             if ids_recv.ndim == 3:  # [1, world, l_max] shard inside shard_map
@@ -504,7 +598,7 @@ class DistributedEmbedding:
 
         # --- rank-specialized local lookup (lax.switch over mesh position) --
         out_widths_list = [
-            [_out_width(self._input_config(r, j), hots[i])
+            [_out_width(self._input_config(r, j), encs[i])
              for j, i in enumerate(ids)]
             for r, ids in enumerate(self.strategy.input_ids_list)]
         s_max = max(max((sum(ws) for ws in out_widths_list), default=1), 1)
@@ -513,9 +607,8 @@ class DistributedEmbedding:
             ids = self.strategy.input_ids_list[rank]
             parsed, pos = [], 0
             for i in ids:
-                seg = lax.slice(recv, (0, pos), (world, pos + b * hots[i]))
-                parsed.append(seg.reshape(world * b, hots[i]))
-                pos += b * hots[i]
+                parsed.append(self._parse_block(recv, pos, encs[i], b))
+                pos += _block_len(encs[i], b)
             outs = self._lookup_local(params_, rank, parsed, flatten_2d=True)
             dt = self.compute_dtype or next(iter(params_.values())).dtype
             if outs:
@@ -556,7 +649,21 @@ class DistributedEmbedding:
         result = [worker_order[i] for i in self.strategy.rev_global_input_ids]
         for start, end in self.strategy.sliced_out_ranges:
             result[start:end] = [jnp.concatenate(result[start:end], axis=-1)]
-        return result, ("dist", ids_recv, hots, b, out_widths_list, s_max)
+        return result, ("dist", ids_recv, encs, b, out_widths_list, s_max)
+
+    def _parse_block(self, recv, pos: int, enc, b: int):
+        """Extract one routed input from a ``[world, l_max]`` exchange block
+        starting at ``pos``: dense → ``[world*b, h]``; ragged → the
+        ``("r", values [world, cap], lengths [world, b])`` record."""
+        world = recv.shape[0]
+        if enc[0] == "d":
+            h = enc[1]
+            seg = lax.slice(recv, (0, pos), (world, pos + b * h))
+            return seg.reshape(world * b, h)
+        cap = enc[1]
+        values = lax.slice(recv, (0, pos), (world, pos + cap))
+        lengths = lax.slice(recv, (0, pos + cap), (world, pos + cap + b))
+        return ("r", values, lengths)
 
     def _input_config(self, rank: int, j: int):
         """Config of the table serving the j-th input routed to ``rank``."""
@@ -583,6 +690,26 @@ class DistributedEmbedding:
             vals = jnp.repeat(grad, h, axis=0)
         return ids.reshape(-1), vals
 
+    def _ragged_combiner_backward(self, grad, values, lengths, combiner):
+        """Ragged-input combiner backward: per-value gradient rows.
+
+        ``grad [S*b, width]`` is the combined output's cotangent; each value
+        position gets its segment's grad row (÷ count for mean). Invalid
+        (padding) positions get id ``-1`` so the caller's range check routes
+        them to the dropped sentinel."""
+        if values.ndim == 1:
+            values, lengths = values[None], lengths[None]
+        S, cap = values.shape
+        b = lengths.shape[1]
+        gseg, valid = self._ragged_segments(cap, lengths)
+        gclip = jnp.clip(gseg, 0, S * b - 1)
+        vals = jnp.take(grad, gclip, axis=0, mode="clip")
+        if combiner == "mean":
+            counts = jnp.maximum(lengths.reshape(-1), 1).astype(vals.dtype)
+            vals = vals / jnp.take(counts, gclip, mode="clip")[:, None]
+        ids = jnp.where(valid, values.reshape(-1), -1)
+        return ids, vals
+
     def _rank_sparse_update(self, rank: int, params: EmbedParams, opt_state,
                             parsed_inputs, grads, optimizer, lr, scale):
         """Apply sparse updates for one rank's tables.
@@ -596,8 +723,13 @@ class DistributedEmbedding:
             m = self.strategy.local_map_list[rank][j]
             cfg = self.strategy.local_configs_list[rank][m]
             k, roff, rows, w = self._table_rows(rank, m)
-            ids, vals = self._combiner_backward(grad, inp, cfg.get("combiner"))
             cap = self.rows_cap[w]
+            if isinstance(inp, tuple) and inp[0] == "r":
+                ids, vals = self._ragged_combiner_backward(
+                    grad, inp[1], inp[2], cfg.get("combiner"))
+            else:
+                ids, vals = self._combiner_backward(
+                    grad, inp, cfg.get("combiner"))
             shifted = jnp.where((ids >= 0) & (ids < rows), ids + roff, cap)
             per_width.setdefault(k, []).append((shifted, vals))
         new_params = dict(params)
@@ -653,7 +785,7 @@ class DistributedEmbedding:
             return self._rank_sparse_update(
                 0, params, opt_state, inputs, grads, optimizer, lr, scale)
 
-        _, ids_recv, hots, b, out_widths_list, s_max = residuals
+        _, ids_recv, encs, b, out_widths_list, s_max = residuals
         world = self.world_size
 
         # Invert the column-slice collapse then the input-order reorder,
@@ -702,9 +834,8 @@ class DistributedEmbedding:
         def branch(rank, params_, state_, recv, grad):
             parsed, pos = [], 0
             for i in self.strategy.input_ids_list[rank]:
-                seg = lax.slice(recv, (0, pos), (world, pos + b * hots[i]))
-                parsed.append(seg.reshape(world * b, hots[i]))
-                pos += b * hots[i]
+                parsed.append(self._parse_block(recv, pos, encs[i], b))
+                pos += _block_len(encs[i], b)
             gslices, gpos = [], 0
             for w in out_widths_list[rank]:
                 gslices.append(lax.slice(grad, (0, gpos),

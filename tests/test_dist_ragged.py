@@ -1,0 +1,244 @@
+"""Ragged (variable-hotness) inputs through the distributed path.
+
+VERDICT r1 #9: the reference's variable-hotness kernel capability is
+reachable from ``DistributedEmbedding`` through the ``Embedding`` layers it
+owns; here the static-capacity CSR encoding travels inside the padded id
+all-to-all as ``[values(cap), lengths(b)]`` blocks. Tests use the
+single-process-reference pattern: dist-vs-oracle forward equality with mixed
+ragged/dense features, then one SGD step both via shard_map autodiff and via
+the sparse trainer, comparing updated weights.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_embeddings_tpu.ops import embedding_lookup
+from distributed_embeddings_tpu.ops.embedding_lookup import Ragged
+from distributed_embeddings_tpu.parallel import (
+    DistributedEmbedding,
+    SparseSGD,
+    hybrid_value_and_grad,
+    init_hybrid_state,
+    make_hybrid_train_step,
+)
+
+WORLD = 8
+LOCAL_B = 3
+MAX_HOT = 4
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = jax.devices()
+    assert len(devs) >= WORLD, "conftest should force 8 CPU devices"
+    return Mesh(np.array(devs[:WORLD]), ("data",))
+
+
+def ragged_model(rng, num_tables=10):
+    configs, kinds = [], []
+    for i in range(num_tables):
+        width = int(rng.integers(1, 9))
+        rows = int(rng.integers(4, 100))
+        ragged = bool(i % 2 == 0)
+        combiner = (str(rng.choice(["sum", "mean"])) if ragged
+                    else rng.choice([None, "sum", "mean"]))
+        configs.append({"input_dim": rows, "output_dim": width,
+                        "combiner": combiner})
+        kinds.append("ragged" if ragged else "dense")
+    return configs, kinds
+
+
+def make_mixed_inputs(rng, configs, kinds):
+    """Per-feature global inputs: ragged features as stacked per-shard
+    static-capacity CSR (shard s owns leaf rows ``s*cap:(s+1)*cap`` /
+    ``s*(b+1):(s+1)*(b+1)``), dense features as ``[WORLD*b, hot]``."""
+    cap = LOCAL_B * MAX_HOT
+    dist_inputs, shard_rows = [], []
+    for cfg, kind in zip(configs, kinds):
+        if kind == "dense":
+            hot = int(rng.integers(1, 5)) if cfg["combiner"] else 1
+            ids = rng.integers(0, cfg["input_dim"],
+                               size=(WORLD * LOCAL_B, hot))
+            dist_inputs.append(jnp.asarray(ids, jnp.int32))
+            shard_rows.append(None)
+            continue
+        rows_per_shard = []
+        vals_parts, split_parts = [], []
+        for s in range(WORLD):
+            rows = [list(rng.integers(0, cfg["input_dim"],
+                                      size=int(rng.integers(0, MAX_HOT + 1))))
+                    for _ in range(LOCAL_B)]
+            rows_per_shard.append(rows)
+            r = Ragged.from_lists(rows, capacity=cap)
+            vals_parts.append(r.values)
+            split_parts.append(r.row_splits)
+        dist_inputs.append(Ragged(values=jnp.concatenate(vals_parts),
+                                  row_splits=jnp.concatenate(split_parts)))
+        shard_rows.append(rows_per_shard)
+    return dist_inputs, shard_rows
+
+
+def oracle_forward(tables, configs, kinds, dist_inputs, shard_rows):
+    cap = LOCAL_B * MAX_HOT
+    outs = []
+    for i, (cfg, kind) in enumerate(zip(configs, kinds)):
+        t = jnp.asarray(tables[i])
+        if kind == "dense":
+            o = embedding_lookup(t, dist_inputs[i], combiner=cfg["combiner"])
+            outs.append(o.reshape(o.shape[0], -1))
+            continue
+        shard_outs = [
+            embedding_lookup(t, Ragged.from_lists(rows, capacity=cap),
+                             combiner=cfg["combiner"])
+            for rows in shard_rows[i]]
+        outs.append(jnp.concatenate(shard_outs, axis=0))
+    return outs
+
+
+def dist_forward(de, mesh, flat, dist_inputs):
+    def fwd(params, inps):
+        return tuple(de(params, list(inps)))
+
+    return jax.jit(jax.shard_map(
+        fwd, mesh=mesh,
+        in_specs=(P("data"), P("data")),
+        out_specs=P("data")))(flat, tuple(dist_inputs))
+
+
+@pytest.mark.parametrize("strategy,column_slice_threshold",
+                         [("basic", None), ("memory_balanced", None),
+                          ("memory_balanced", 150)])
+def test_ragged_forward_matches_oracle(mesh, strategy, column_slice_threshold):
+    rng = np.random.default_rng(41)
+    configs, kinds = ragged_model(rng)
+    de = DistributedEmbedding(configs, world_size=WORLD, strategy=strategy,
+                              column_slice_threshold=column_slice_threshold)
+    flat = de.init(jax.random.key(0), mesh=mesh)
+    tables = de.get_weights(flat)
+    dist_inputs, shard_rows = make_mixed_inputs(rng, configs, kinds)
+
+    expect = oracle_forward(tables, configs, kinds, dist_inputs, shard_rows)
+    outs = dist_forward(de, mesh, flat, dist_inputs)
+    assert len(outs) == len(expect)
+    for o, e in zip(outs, expect):
+        np.testing.assert_allclose(np.asarray(o), np.asarray(e),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_ragged_world1_matches_oracle():
+    rng = np.random.default_rng(43)
+    configs, kinds = ragged_model(rng, num_tables=6)
+    de = DistributedEmbedding(configs, world_size=1)
+    flat = de.init(jax.random.key(2))
+    tables = de.get_weights(flat)
+    dist_inputs, shard_rows = make_mixed_inputs(rng, configs, kinds)
+    # world1: single "shard" holding everything — rebuild at global batch
+    cap = LOCAL_B * MAX_HOT
+    flat_inputs = []
+    for i, kind in enumerate(kinds):
+        if kind == "dense":
+            flat_inputs.append(dist_inputs[i])
+        else:
+            rows = [r for shard in shard_rows[i] for r in shard]
+            flat_inputs.append(Ragged.from_lists(rows, capacity=WORLD * cap))
+    outs = de(flat, flat_inputs)
+    expect = oracle_forward(tables, configs, kinds, dist_inputs, shard_rows)
+    for o, e in zip(outs, expect):
+        # world1 preserves original output rank (reference call semantics);
+        # the oracle is flattened to the distributed layout
+        o = np.asarray(o).reshape(np.asarray(o).shape[0], -1)
+        np.testing.assert_allclose(o, np.asarray(e), rtol=1e-5, atol=1e-6)
+
+
+def test_ragged_sgd_step_matches_oracle(mesh):
+    """Autodiff backward through the ragged exchange (hybrid_value_and_grad)."""
+    rng = np.random.default_rng(47)
+    configs, kinds = ragged_model(rng)
+    de = DistributedEmbedding(configs, world_size=WORLD,
+                              strategy="memory_balanced")
+    tables0 = [rng.normal(size=(c["input_dim"], c["output_dim"])
+                          ).astype(np.float32) for c in configs]
+    flat = de.set_weights(tables0, mesh=mesh)
+    dist_inputs, shard_rows = make_mixed_inputs(rng, configs, kinds)
+    lr = 0.5
+
+    def local_loss(params, inps):
+        outs = de(params, list(inps))
+        return sum(jnp.mean(o ** 2) for o in outs)
+
+    def step(params, inps):
+        _, grads = hybrid_value_and_grad(
+            local_loss, mp_mask=True, axis_name="data")(params, inps)
+        return jax.tree.map(lambda p, g: p - lr * g, params, grads)
+
+    new_flat = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P("data"), P("data")),
+        out_specs=P("data")))(flat, tuple(dist_inputs))
+    dist_tables = de.get_weights(new_flat)
+
+    def ref_loss(tables):
+        outs = oracle_forward(tables, configs, kinds, dist_inputs, shard_rows)
+        return sum(jnp.mean(o ** 2) for o in outs)
+
+    ref_grads = jax.grad(ref_loss)([jnp.asarray(t) for t in tables0])
+    ref_tables = [t - lr * g for t, g in zip(tables0, ref_grads)]
+    for a, b in zip(dist_tables, ref_tables):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("column_slice_threshold", [None, 150])
+def test_ragged_sparse_trainer_step_matches_oracle(mesh,
+                                                   column_slice_threshold):
+    """The manual IndexedSlices-style backward (sparse_apply_gradients) with
+    ragged features — including through column-sliced tables — trajectory-
+    checked against a dense-autodiff oracle."""
+    rng = np.random.default_rng(53)
+    configs, kinds = ragged_model(rng)
+    de = DistributedEmbedding(configs, world_size=WORLD,
+                              strategy="memory_balanced",
+                              column_slice_threshold=column_slice_threshold)
+    tables0 = [rng.normal(size=(c["input_dim"], c["output_dim"])
+                          ).astype(np.float32) for c in configs]
+    flat = de.set_weights(tables0, mesh=mesh)
+    dist_inputs, shard_rows = make_mixed_inputs(rng, configs, kinds)
+    lr = 0.3
+
+    emb_opt = SparseSGD()
+    tx = optax.sgd(lr)
+    total_w = sum(int(c["output_dim"]) for c in configs)
+    dense_params = {"w": jnp.asarray(rng.normal(size=(total_w, 1)),
+                                     jnp.float32)}
+
+    def loss_fn(dp, emb_outs, batch):
+        x = jnp.concatenate([o.reshape(o.shape[0], -1) for o in emb_outs],
+                            axis=1)
+        pred = x @ dp["w"]
+        return jnp.mean((pred - batch) ** 2)
+
+    state = init_hybrid_state(de, emb_opt, dense_params, tx,
+                              jax.random.key(1), mesh=mesh)
+    state = state._replace(emb_params=flat,
+                           emb_opt_state=emb_opt.init(flat))
+    step_fn = make_hybrid_train_step(de, loss_fn, tx, emb_opt, mesh=mesh,
+                                     lr_schedule=lr)
+    labels = jnp.asarray(rng.normal(size=(WORLD * LOCAL_B, 1)), jnp.float32)
+    dense0 = jax.tree.map(np.asarray, dense_params)  # pre-donation snapshot
+    _, state = step_fn(state, tuple(dist_inputs), labels)
+    dist_tables = de.get_weights(state.emb_params)
+
+    def ref_loss(tables, dp):
+        outs = oracle_forward(tables, configs, kinds, dist_inputs, shard_rows)
+        return loss_fn(dp, outs, labels)
+
+    ref_grads, dense_grads = jax.grad(ref_loss, argnums=(0, 1))(
+        [jnp.asarray(t) for t in tables0],
+        jax.tree.map(jnp.asarray, dense0))
+    ref_tables = [t - lr * g for t, g in zip(tables0, ref_grads)]
+    for a, b in zip(dist_tables, ref_tables):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
