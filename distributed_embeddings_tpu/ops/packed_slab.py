@@ -1,0 +1,138 @@
+"""Lane-packed slab layout for narrow embedding tables.
+
+XLA's TPU row gather/scatter has a fast path when rows are full 128-lane
+tiles: measured on v5e, a random 2M-row gather from a ``[2M, 128]`` table
+runs at ~10 ns/row and scatter-add at ~15 ns/row, while the same gather from
+a ``[8M, 16]`` table costs ~22 ns/row and scatter-add ~100 ns/row (the
+sub-tile rows take a serialized path; see ``docs/perf_tpu.md``). The
+reference meets the same hardware reality on GPUs with width-specialized
+kernels (``cc/kernels/embedding_lookup_kernels.cu:397-453`` switches tile
+shapes by power-of-2 width).
+
+Here narrow tables pack ``p = 128 // width`` logical rows into each 128-lane
+physical row:
+
+* logical row ``L`` lives at physical row ``L // p``, lanes
+  ``[(L % p) * w, (L % p + 1) * w)``;
+* gathers fetch physical rows and extract lanes with a vectorized select;
+* scatters expand ``[n, w]`` update rows into lane-placed ``[n, 128]`` rows
+  and hit the full-tile scatter path — lane-disjoint expansion keeps
+  duplicate handling and per-row optimizer semantics exact (different
+  logical rows of one physical row touch disjoint lanes).
+
+Tables with ``width >= 128`` keep their natural layout (``p == 1``).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LANES = 128
+
+
+def pack_factor(width: int) -> int:
+    """Logical rows per physical row: ``floor(128/w)`` for narrow tables,
+    1 for ``w >= 128`` (already full tiles)."""
+    return max(1, LANES // int(width))
+
+
+def phys_width(width: int) -> int:
+    """Physical row width: 128 lanes when packed, the natural width when
+    ``p == 1`` (w >= 128)."""
+    return LANES if pack_factor(width) > 1 else int(width)
+
+
+def align_rows(rows: int, width: int) -> int:
+    """Logical row count rounded up to a physical-row boundary (tables are
+    laid out at physical boundaries so they never share a physical row)."""
+    p = pack_factor(width)
+    return -(-int(rows) // p) * p
+
+
+def packed_shape(rows_aligned: int, width: int) -> Tuple[int, int]:
+    """Physical ``(rows, cols)`` of a packed buffer holding ``rows_aligned``
+    (already aligned) logical rows."""
+    p = pack_factor(width)
+    assert rows_aligned % p == 0
+    return rows_aligned // p, phys_width(width)
+
+
+def pack_rows_np(chunk: np.ndarray, width: int) -> np.ndarray:
+    """Host-side pack of ``[n, w]`` logical rows (n a multiple of p) into
+    ``[n/p, phys_width]`` physical rows."""
+    p = pack_factor(width)
+    if p == 1:
+        return chunk
+    n = chunk.shape[0]
+    assert n % p == 0, (n, p)
+    out = np.zeros((n // p, LANES), chunk.dtype)
+    out[:, :p * width] = chunk.reshape(n // p, p * width)
+    return out
+
+
+def unpack_rows_np(phys: np.ndarray, width: int) -> np.ndarray:
+    """Host-side inverse of :func:`pack_rows_np`: ``[m, phys_width]`` →
+    ``[m*p, w]`` logical rows."""
+    p = pack_factor(width)
+    if p == 1:
+        return phys
+    m = phys.shape[0]
+    return phys[:, :p * width].reshape(m * p, width)
+
+
+def pack_rows(x: jax.Array, width: int) -> jax.Array:
+    """Device-side :func:`pack_rows_np`: ``[n, w]`` (n a multiple of p) →
+    ``[n/p, phys_width]``."""
+    p = pack_factor(width)
+    if p == 1:
+        return x
+    n = x.shape[0]
+    assert n % p == 0, (n, p)
+    out = x.reshape(n // p, p * width)
+    pad = LANES - p * width
+    if pad:
+        out = jnp.concatenate(
+            [out, jnp.zeros((n // p, pad), x.dtype)], axis=1)
+    return out
+
+
+def packed_gather(slab: jax.Array, logical_ids: jax.Array,
+                  width: int) -> jax.Array:
+    """Gather logical rows from a packed slab: ``[..., w]`` for any id
+    shape. Fetches full physical rows (fast path) and lane-extracts."""
+    p = pack_factor(width)
+    if p == 1:
+        return jnp.take(slab, logical_ids, axis=0, mode="clip")
+    flat = logical_ids.reshape(-1)
+    rows = jnp.take(slab, flat // p, axis=0, mode="clip")  # [n, LANES]
+    lane = (flat % p).astype(jnp.int32)
+    out = rows[:, :width]
+    for j in range(1, p):
+        out = jnp.where((lane == j)[:, None],
+                        rows[:, j * width:(j + 1) * width], out)
+    return out.reshape(*logical_ids.shape, width)
+
+
+def expand_update_rows(vals: jax.Array, logical_ids: jax.Array,
+                       width: int) -> Tuple[jax.Array, jax.Array]:
+    """Turn ``[n, w]`` update rows at logical ids into ``(phys_ids,
+    [n, phys_width])`` lane-placed rows for a full-tile scatter. Out-of-range
+    logical ids stay out of range physically (``L // p`` of a sentinel past
+    the aligned capacity lands past the physical capacity)."""
+    p = pack_factor(width)
+    if p == 1:
+        return logical_ids, vals
+    lane = (logical_ids % p).astype(jnp.int32)
+    zero = jnp.zeros_like(vals)
+    expanded = jnp.concatenate(
+        [jnp.where((lane == j)[:, None], vals, zero) for j in range(p)],
+        axis=1)
+    pad = LANES - p * width
+    if pad:
+        expanded = jnp.concatenate(
+            [expanded, jnp.zeros((vals.shape[0], pad), vals.dtype)], axis=1)
+    return logical_ids // p, expanded

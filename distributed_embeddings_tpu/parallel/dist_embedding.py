@@ -11,14 +11,17 @@ JAX SPMD instead of Horovod MPMD:
   device inside ``jax.shard_map``; per-rank table heterogeneity is expressed as
   ``lax.switch`` over rank-specialized lookup branches, each with fully static
   shapes (table row offsets, hotness, widths) so XLA tiles them onto the MXU.
-* **Parameters as width-grouped stacked tables.** Each rank's tables of width
-  ``w`` stack row-major into one 2-D slab; the global parameter is a dict
-  ``{width: [world, rows_cap_w, w]}`` sharded over the mesh axis. Stacking by
-  width keeps every embedding read/update a native 2-D row gather/scatter —
-  the layout XLA's TPU backend has fast paths for (1-D element/windowed
-  scatters lower to a serialized path, ~30x slower end-to-end) — and gives
-  SPMD-uniform pytree shapes across ranks (padding rows absorb imbalance).
-  This replaces the reference's per-rank ``tf.Variable`` lists.
+* **Parameters as width-grouped, lane-packed stacked tables.** Each rank's
+  tables of width ``w`` stack row-major into one 2-D slab, and narrow widths
+  pack ``p = 128//w`` logical rows per 128-lane physical row
+  (``ops/packed_slab.py``): the global parameter is a dict
+  ``{width: [world, phys_cap_w, phys_w]}`` sharded over the mesh axis, where
+  ``phys_w = 128`` for ``w < 128`` and ``w`` otherwise. Full-tile rows are
+  the layout XLA's TPU backend has fast row-gather/scatter paths for
+  (measured ~10/15 ns per row vs ~22/100 ns for sub-tile rows — see
+  ``docs/perf_tpu.md``), and the width grouping gives SPMD-uniform pytree
+  shapes across ranks (padding rows absorb imbalance). This replaces the
+  reference's per-rank ``tf.Variable`` lists.
 * **Collectives.** ``hvd.alltoall(splits=...)`` (variable splits,
   ``dist_model_parallel.py:282``) has no ragged JAX primitive on every backend,
   so id blocks are padded to the max per-rank split and exchanged with
@@ -48,7 +51,8 @@ from flax import struct
 from jax import lax
 
 from ..layers.embedding import default_embeddings_init
-from ..ops.embedding_lookup import Ragged, embedding_lookup, ragged_row_ids
+from ..ops.embedding_lookup import Ragged, ragged_row_ids
+from ..ops import packed_slab as ps
 from .strategy import DistEmbeddingStrategy
 
 EmbedParams = Dict[str, jax.Array]
@@ -188,44 +192,86 @@ class DistributedEmbedding:
 
         # Width-grouped stacked-table layout: per rank, tables of equal width
         # stack row-major into one 2-D slab; slab row capacity is the max over
-        # ranks so the params pytree is SPMD-uniform.
+        # ranks so the params pytree is SPMD-uniform. Narrow widths store
+        # lane-PACKED (p = 128//w logical rows per physical 128-lane row, see
+        # ops/packed_slab.py) so row gathers/scatters hit XLA's full-tile
+        # fast path; each table starts at a physical-row boundary.
         widths = sorted({int(c["output_dim"])
                          for cfgs in self.strategy.local_configs_list
                          for c in cfgs})
         self.widths: List[int] = widths
-        # row_offsets_list[rank][m] = first row of local table m in its slab
+        # row_offsets_list[rank][m] = first LOGICAL row of local table m
         self.row_offsets_list: List[List[int]] = []
-        per_rank_rows = []  # [rank][width] -> rows used
+        per_rank_rows = []  # [rank][width] -> logical rows used (aligned)
         for cfgs in self.strategy.local_configs_list:
             used = {w: 0 for w in widths}
             offsets = []
             for c in cfgs:
                 w = int(c["output_dim"])
                 offsets.append(used[w])
-                used[w] += int(c["input_dim"])
+                used[w] += ps.align_rows(int(c["input_dim"]), w)
             self.row_offsets_list.append(offsets)
             per_rank_rows.append(used)
         self.rows_cap: Dict[int, int] = {
-            w: max(max(r[w] for r in per_rank_rows), 1) for w in widths}
+            w: max(max(max(r[w] for r in per_rank_rows), 1),
+                   ps.pack_factor(w)) for w in widths}
+        # physical slab geometry per width
+        self.phys_cap: Dict[int, int] = {
+            w: ps.packed_shape(ps.align_rows(self.rows_cap[w], w), w)[0]
+            for w in widths}
+        self.phys_w: Dict[int, int] = {w: ps.phys_width(w) for w in widths}
+        self.rows_cap = {w: ps.align_rows(self.rows_cap[w], w)
+                         for w in widths}
 
     # ------------------------------------------------------------------ params
 
     def _init_rank_width(self, key, rank: int, width: int, dtype) -> jax.Array:
-        """One rank's slab for one width: per-table initializers stacked
-        row-major; column slices initialize independently like the reference's
-        per-slice layers (``dist_model_parallel.py:256-259``)."""
+        """One rank's PACKED slab for one width: per-table initializers
+        stacked row-major at physical-row boundaries; column slices
+        initialize independently like the reference's per-slice layers
+        (``dist_model_parallel.py:256-259``).
+
+        The *default* initializer (an elementwise uniform) is generated
+        directly in the packed physical shape — reshaping a logical
+        ``[rows, w]`` slab on device would force a lane-padded T(8,128)
+        intermediate (8x memory for w=16, an instant OOM at zoo scale), and
+        for an elementwise distribution the layout is immaterial. A
+        *user-supplied* initializer keeps its documented contract: it is
+        called with the logical ``(rows, w)`` shape (shape-dependent
+        initializers like ``variance_scaling`` see the true fan-in/out) and
+        the result is packed with strided slices, avoiding the padded
+        reshape."""
+        p = ps.pack_factor(width)
+        pw = self.phys_w[width]
         cfgs = self.strategy.local_configs_list[rank]
         parts = []
         for m, cfg in enumerate(cfgs):
             if int(cfg["output_dim"]) != width:
                 continue
-            init = cfg.get("embeddings_initializer") or default_embeddings_init
-            shape = (int(cfg["input_dim"]), width)
-            parts.append(init(jax.random.fold_in(key, m), shape, dtype))
-        total = sum(p.shape[0] for p in parts)
-        pad = self.rows_cap[width] - total
+            user_init = cfg.get("embeddings_initializer")
+            rows = int(cfg["input_dim"])
+            rows_al = ps.align_rows(rows, width)
+            if user_init is None:
+                t = default_embeddings_init(
+                    jax.random.fold_in(key, m),
+                    (rows_al // p, p * width), dtype)
+            else:
+                t = user_init(jax.random.fold_in(key, m), (rows, width),
+                              dtype)
+                if rows_al - rows:
+                    t = jnp.concatenate(
+                        [t, jnp.zeros((rows_al - rows, width), dtype)])
+                if p > 1:  # pack: phys row i, lane j <- logical row i*p+j
+                    t = jnp.concatenate([t[j::p] for j in range(p)], axis=1)
+            if p * width < pw:  # odd widths: pad dead lanes
+                t = jnp.concatenate(
+                    [t, jnp.zeros((t.shape[0], pw - p * width), dtype)],
+                    axis=1)
+            parts.append(t)
+        total = sum(part.shape[0] for part in parts)
+        pad = self.phys_cap[width] - total
         if pad:
-            parts.append(jnp.zeros((pad, width), dtype))
+            parts.append(jnp.zeros((pad, pw), dtype))
         return jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
 
     def init(self, key, dtype=jnp.float32, mesh=None) -> EmbedParams:
@@ -269,10 +315,11 @@ class DistributedEmbedding:
         return out
 
     def _assemble_sharded(self, mesh, width: int, build_shard) -> jax.Array:
-        """Assemble one width's global ``[world, rows_cap, w]`` slab from
-        per-device shards built by ``build_shard(dev, r0, r1)`` — only this
-        process's addressable shards are materialized (multi-host safe)."""
-        shape = (self.world_size, self.rows_cap[width], width)
+        """Assemble one width's global packed ``[world, phys_cap, phys_w]``
+        slab from per-device shards built by ``build_shard(dev, r0, r1)`` —
+        only this process's addressable shards are materialized (multi-host
+        safe)."""
+        shape = (self.world_size, self.phys_cap[width], self.phys_w[width])
         sharding = jax.sharding.NamedSharding(
             mesh, jax.sharding.PartitionSpec(self.axis_name))
         arrays = []
@@ -363,7 +410,7 @@ class DistributedEmbedding:
         gseg = jnp.where(valid, src * b + seg, S * b).reshape(-1)
         return gseg, valid.reshape(-1)
 
-    def _ragged_block_combine(self, slab, roff, rows, values, lengths,
+    def _ragged_block_combine(self, slab, roff, rows, width, values, lengths,
                               combiner):
         """Fused lookup+combine for a routed ragged feature: ``values
         [S, cap]`` / ``lengths [S, b]`` hold one static-capacity CSR block
@@ -372,8 +419,8 @@ class DistributedEmbedding:
         b = lengths.shape[1]
         gseg, _ = self._ragged_segments(cap, lengths)
         ids = (jnp.clip(values, 0, rows - 1) + roff).reshape(-1)
-        gathered = jnp.take(slab, ids, axis=0, mode="clip")
-        out = jnp.zeros((S * b + 1, slab.shape[1]), gathered.dtype)
+        gathered = ps.packed_gather(slab, ids, width)
+        out = jnp.zeros((S * b + 1, gathered.shape[1]), gathered.dtype)
         out = out.at[gseg].add(gathered, mode="drop")[:S * b]
         if combiner == "mean":
             counts = jnp.maximum(lengths.reshape(-1), 1).astype(out.dtype)
@@ -492,11 +539,18 @@ class DistributedEmbedding:
                 if values.ndim == 1:
                     values, lengths = values[None], lengths[None]
                 o = self._ragged_block_combine(
-                    slab, roff, rows, values, lengths, cfg.get("combiner"))
+                    slab, roff, rows, w, values, lengths, cfg.get("combiner"))
                 outs.append(o)
                 continue
             shifted = jnp.clip(inp, 0, rows - 1) + roff
-            o = embedding_lookup(slab, shifted, combiner=cfg.get("combiner"))
+            gathered = ps.packed_gather(slab, shifted, w)  # ids.shape + (w,)
+            combiner = cfg.get("combiner")
+            if combiner == "sum":
+                o = jnp.sum(gathered, axis=1)
+            elif combiner == "mean":
+                o = jnp.mean(gathered, axis=1)
+            else:
+                o = gathered
             outs.append(o.reshape(o.shape[0], -1) if flatten_2d else o)
         return outs
 
@@ -731,7 +785,11 @@ class DistributedEmbedding:
                 ids, vals = self._combiner_backward(
                     grad, inp, cfg.get("combiner"))
             shifted = jnp.where((ids >= 0) & (ids < rows), ids + roff, cap)
-            per_width.setdefault(k, []).append((shifted, vals))
+            # lane-expand to physical rows: the scatter (and any dedup in the
+            # optimizer) runs on full-tile rows; lane-disjoint placement keeps
+            # per-logical-row semantics exact (ops/packed_slab.py)
+            phys_ids, pvals = ps.expand_update_rows(vals, shifted, w)
+            per_width.setdefault(k, []).append((phys_ids, pvals))
         new_params = dict(params)
         new_state = dict(opt_state) if isinstance(opt_state, dict) else opt_state
         for k in sorted(per_width):
@@ -945,30 +1003,36 @@ class DistributedEmbedding:
                     full_w = int(
                         self.strategy.global_configs[tid]["output_dim"])
                     out[tid] = np.empty((rows, full_w), v.dtype)
-                chunk_rows = max(1, int(chunk_elems) // max(w, 1))
+                p = ps.pack_factor(w)
+                chunk_rows = max(p, (int(chunk_elems) // max(w, 1)) // p * p)
                 for s in range(0, rows, chunk_rows):
                     n = min(chunk_rows, rows - s)
-                    out[tid][s:s + n, c0:c0 + w] = self._fetch_rows(
-                        v, r, roff + s, n)
+                    phys = self._fetch_rows(
+                        v, r, (roff + s) // p, -(-n // p))
+                    out[tid][s:s + n, c0:c0 + w] = ps.unpack_rows_np(
+                        phys, w)[:n]
         return out
 
     def _build_shard(self, loaded, dev, width: int, r0: int, r1: int,
                      dtype, chunk_elems: int) -> jax.Array:
-        """Stream one device's slab shard ``[r1-r0, rows_cap, width]``:
-        zeros on-device, then donated row-range writes of at most
+        """Stream one device's packed slab shard ``[r1-r0, phys_cap,
+        phys_w]``: zeros on-device, then donated row-range writes of at most
         ``chunk_elems`` elements read straight from the (possibly mmap'd)
-        sources — never a host copy bigger than one chunk."""
+        sources — never a host copy bigger than one chunk. Chunks are packed
+        host-side at physical-row granularity."""
+        p = ps.pack_factor(width)
+        pw = self.phys_w[width]
         with jax.default_device(dev):
-            buf = jnp.zeros((r1 - r0, self.rows_cap[width], width), dtype)
+            buf = jnp.zeros((r1 - r0, self.phys_cap[width], pw), dtype)
         # commit to dev (no-copy) so later ops can't migrate an unwritten
         # buffer back to the default device
         buf = jax.device_put(buf, dev)
         shape3 = buf.shape
-        buf = buf.reshape(-1, width)
+        buf = buf.reshape(-1, pw)
         plan = self._slice_plan()
-        chunk_rows = max(1, int(chunk_elems) // max(width, 1))
+        chunk_rows = max(p, (int(chunk_elems) // max(width, 1)) // p * p)
         for r in range(r0, r1):
-            base = (r - r0) * self.rows_cap[width]
+            base = (r - r0) * self.phys_cap[width]
             for tid, roff, rows, c0, w in plan[r]:
                 if w != width:
                     continue
@@ -980,8 +1044,12 @@ class DistributedEmbedding:
                     n = min(chunk_rows, rows - s)
                     host = np.ascontiguousarray(
                         src[s:s + n, c0:c0 + w], dtype=dtype)
-                    buf = _write_rows(buf, jax.device_put(host, dev),
-                                      base + roff + s)
+                    if n % p:  # pad into the table's alignment padding
+                        host = np.concatenate(
+                            [host, np.zeros((p - n % p, w), host.dtype)])
+                    buf = _write_rows(buf, jax.device_put(
+                        ps.pack_rows_np(host, width), dev),
+                        base + (roff + s) // p)
         return buf.reshape(shape3)
 
     def set_weights(self, weights: Sequence[Any], mesh=None,

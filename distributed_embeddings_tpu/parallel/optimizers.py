@@ -6,8 +6,12 @@ sparse paths (``optimizer.apply_gradients`` after
 has no IndexedSlices, so dense-gradient training would read+write every table
 row each step — the difference between HBM-bound O(touched rows) and
 O(all rows). These optimizers reproduce the sparse behavior on the
-``[rows_cap, width]`` slabs used by
-:class:`~distributed_embeddings_tpu.parallel.DistributedEmbedding`.
+*physical* slab rows used by
+:class:`~distributed_embeddings_tpu.parallel.DistributedEmbedding` — for
+narrow widths those are lane-packed ``[phys_rows, 128]`` tiles and the
+caller hands in physical row ids plus lane-expanded update rows
+(``ops/packed_slab.py``; lane-disjoint expansion keeps per-logical-row
+semantics, including Adagrad's dedup, exact).
 
 Performance notes (TPU): updates are native 2-D row scatters
 (``slab.at[row_ids].add(values)``) — the one scatter form XLA's TPU backend

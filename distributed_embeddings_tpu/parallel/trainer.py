@@ -30,7 +30,8 @@ from .grads import resolve_dp_gradient
 
 class HybridTrainState(NamedTuple):
     """All mutable training state. ``emb_params``/``emb_opt_state`` are the
-    model-parallel slab dicts ``{width: [world, rows_cap, width]}``; the rest
+    model-parallel slab dicts ``{width: [world, phys_rows, phys_width]}``
+    (lane-packed for narrow widths, see ``ops/packed_slab.py``); the rest
     is replicated."""
     emb_params: Any
     emb_opt_state: Any
