@@ -1,0 +1,85 @@
+"""Direct unit tests of the lane-packed slab layout helpers.
+
+The distributed suite exercises packing indirectly through oracles; these
+pin the layout contract itself, including odd widths whose pack leaves dead
+lanes (w=3 → p=42, 126/128 lanes used) and w >= 128 passthrough.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_embeddings_tpu.ops import packed_slab as ps
+
+
+@pytest.mark.parametrize("width", [1, 3, 8, 16, 48, 64, 127, 128, 256])
+def test_geometry(width):
+    p = ps.pack_factor(width)
+    assert p == (1 if width >= 128 else 128 // width)
+    assert ps.phys_width(width) == (width if p == 1 else 128)
+    rows = 1000
+    ra = ps.align_rows(rows, width)
+    assert ra % p == 0 and ra >= rows and ra - rows < p
+    pr, pw = ps.packed_shape(ra, width)
+    assert pr * p == ra and pw == ps.phys_width(width)
+
+
+@pytest.mark.parametrize("width", [3, 16, 48, 128])
+def test_pack_unpack_roundtrip(width):
+    rng = np.random.default_rng(0)
+    p = ps.pack_factor(width)
+    n = 6 * p
+    chunk = rng.normal(size=(n, width)).astype(np.float32)
+    packed = ps.pack_rows_np(chunk, width)
+    assert packed.shape == (n // p, ps.phys_width(width))
+    np.testing.assert_array_equal(ps.unpack_rows_np(packed, width), chunk)
+    # device-side pack agrees with the host pack
+    np.testing.assert_array_equal(
+        np.asarray(ps.pack_rows(jnp.asarray(chunk), width)), packed)
+
+
+@pytest.mark.parametrize("width", [3, 16, 48, 128])
+def test_packed_gather_matches_unpacked(width):
+    rng = np.random.default_rng(1)
+    p = ps.pack_factor(width)
+    rows = ps.align_rows(500, width)
+    logical = rng.normal(size=(rows, width)).astype(np.float32)
+    slab = jnp.asarray(ps.pack_rows_np(logical, width))
+    ids = jnp.asarray(rng.integers(0, 500, size=(257,)), jnp.int32)
+    out = ps.packed_gather(slab, ids, width)
+    np.testing.assert_array_equal(np.asarray(out), logical[np.asarray(ids)])
+    # 2-D id shapes keep their shape
+    ids2 = ids[:256].reshape(64, 4)
+    out2 = ps.packed_gather(slab, ids2, width)
+    assert out2.shape == (64, 4, width)
+    np.testing.assert_array_equal(
+        np.asarray(out2), logical[np.asarray(ids2)])
+
+
+@pytest.mark.parametrize("width", [3, 16, 128])
+def test_expand_update_rows_scatter_equivalence(width):
+    """Scatter-add of lane-expanded rows == logical scatter-add, including
+    duplicate logical ids and the OOB sentinel."""
+    rng = np.random.default_rng(2)
+    p = ps.pack_factor(width)
+    rows = ps.align_rows(96, width)
+    logical0 = rng.normal(size=(rows, width)).astype(np.float32)
+    slab = jnp.asarray(ps.pack_rows_np(logical0, width))
+
+    n = 300
+    ids = rng.integers(0, 96, size=(n,))
+    ids[::17] = rows  # sentinel: dropped
+    ids = jnp.asarray(ids, jnp.int32)
+    vals = jnp.asarray(rng.normal(size=(n, width)), jnp.float32)
+
+    phys_ids, pvals = ps.expand_update_rows(vals, ids, width)
+    assert pvals.shape[1] == ps.phys_width(width)
+    new_slab = slab.at[phys_ids].add(pvals, mode="drop")
+
+    want = logical0.copy()
+    for i, idv in enumerate(np.asarray(ids)):
+        if idv < rows:
+            want[idv] += np.asarray(vals)[i]
+    got = ps.unpack_rows_np(np.asarray(new_slab), width)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
