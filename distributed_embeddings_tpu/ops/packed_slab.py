@@ -25,6 +25,7 @@ Tables with ``width >= 128`` keep their natural layout (``p == 1``).
 
 from __future__ import annotations
 
+import os
 from typing import Tuple
 
 import jax
@@ -32,6 +33,17 @@ import jax.numpy as jnp
 import numpy as np
 
 LANES = 128
+
+# Debug switch for lane extraction in :func:`packed_gather`. The default
+# one-hot contraction is the fastest form measured, but 0*NaN=NaN means one
+# non-finite table row contaminates gathers of the other p-1 logical rows
+# sharing its physical row, which muddies blast-radius diagnosis of a
+# divergence. Setting this True (or env DETPU_DEBUG_LANE_EXTRACT=1) swaps in
+# a where/select chain that touches only the addressed lane, isolating
+# non-finite rows exactly. Slower (~1.8x on the extract step) — debugging
+# only, never needed for training health.
+DEBUG_LANE_EXTRACT = bool(int(os.environ.get(
+    "DETPU_DEBUG_LANE_EXTRACT", "0")))
 
 
 def pack_factor(width: int) -> int:
@@ -120,10 +132,17 @@ def packed_gather(slab: jax.Array, logical_ids: jax.Array,
     # logical rows sharing its physical row — a debugging (not training-
     # health) concern, since any non-finite table row means training is
     # already broken.
-    oh = jax.nn.one_hot(lane, p, dtype=rows.dtype)
     r3 = rows[:, :p * width].reshape(-1, p, width)
-    out = jnp.einsum("np,npw->nw", oh, r3,
-                     precision=jax.lax.Precision.HIGHEST)
+    if DEBUG_LANE_EXTRACT:
+        # NaN-isolating select chain: only the addressed lane is read, so a
+        # corrupted row cannot poison its physical-row neighbours.
+        out = r3[:, 0, :]
+        for j in range(1, p):
+            out = jnp.where((lane == j)[:, None], r3[:, j, :], out)
+    else:
+        oh = jax.nn.one_hot(lane, p, dtype=rows.dtype)
+        out = jnp.einsum("np,npw->nw", oh, r3,
+                         precision=jax.lax.Precision.HIGHEST)
     return out.reshape(*logical_ids.shape, width)
 
 

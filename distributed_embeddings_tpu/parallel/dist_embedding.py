@@ -8,9 +8,14 @@ JAX SPMD instead of Horovod MPMD:
 
 * **One program, W mesh positions.** The reference runs one Python process per
   GPU, each building only its local tables. Here a single program runs on every
-  device inside ``jax.shard_map``; per-rank table heterogeneity is expressed as
-  ``lax.switch`` over rank-specialized lookup branches, each with fully static
-  shapes (table row offsets, hotness, widths) so XLA tiles them onto the MXU.
+  device inside ``jax.shard_map``; per-rank table heterogeneity is *data*, not
+  program: the exchange is laid out as rank-uniform group regions at static
+  offsets, and small per-rank plan tensors (``parallel/plan.py``) indexed by
+  ``lax.axis_index`` tell each device which table rows its slots read. One
+  compiled program serves every rank — O(#groups) heavy HLO ops, independent
+  of world size and table count (an earlier design's ``lax.switch`` over
+  rank-specialized branches compiled O(world x tables) HLO and hit a
+  compile-time cliff at the 2002-table colossal scale).
 * **Parameters as width-grouped, lane-packed stacked tables.** Each rank's
   tables of width ``w`` stack row-major into one 2-D slab, and narrow widths
   pack ``p = 128//w`` logical rows per 128-lane physical row
@@ -34,7 +39,11 @@ Input contract (distributed path): per feature either a dense int array
 (``[local_batch]`` or ``[local_batch, hotness]``) or a static-capacity
 :class:`~..ops.embedding_lookup.Ragged` (values ``[cap]``, row_splits
 ``[local_batch+1]``; combiner required), identical batch and capacities on
-every rank. Ragged features travel inside the padded id all-to-all as
+every rank. **Ids must lie in ``[0, input_dim)``** — same contract as the
+reference (TF's gather on out-of-range ids is undefined on GPU). Out-of-range
+ids here are clipped in the forward (a safety net so a bad id cannot read a
+neighbouring table in the slab) but routed to the dropped sentinel in the
+sparse backward, so a clipped id trains nothing: don't rely on the clip. Ragged features travel inside the padded id all-to-all as
 ``[values(cap), lengths(b)]`` blocks — the variable-hotness capability the
 reference reaches through its custom kernel (``embedding_lookup_ops.py:79-80``).
 """
@@ -53,6 +62,7 @@ from jax import lax
 from ..layers.embedding import default_embeddings_init
 from ..ops.embedding_lookup import Ragged, ragged_row_ids
 from ..ops import packed_slab as ps
+from . import plan as plan_mod
 from .strategy import DistEmbeddingStrategy
 
 EmbedParams = Dict[str, jax.Array]
@@ -85,10 +95,12 @@ class MpInputs:
     * ``packed``: ``[world_dest, world_src, l_max]`` globally (shard over the
       mesh axis on dim 0; inside ``shard_map`` each device sees
       ``[1, world_src, l_max]``). Row ``[r, s]`` holds source-shard ``s``'s
-      local batch of ids for every input owned by rank ``r``, concatenated in
-      ``input_ids_list[r]`` order and zero-padded to ``l_max``.
-    * ``hots``: static per-global-input hotness (all ranks compile all switch
-      branches, so hotness must be globally known).
+      local batch of ids for every input owned by rank ``r``, laid out in the
+      rank-uniform group-region format of ``parallel/plan.py`` (the same
+      layout the dp path's id all-to-all produces).
+    * ``hots``: static per-global-input encoding — an int (dense hotness) or
+      ``("r", capacity)`` for a ragged feature. Must be globally known (the
+      exchange layout is derived from it).
     * ``local_batch``: static per-shard batch size ``b``.
     """
 
@@ -97,23 +109,9 @@ class MpInputs:
     local_batch: int = struct.field(pytree_node=False)
 
 
-def _out_width(config, enc) -> int:
-    """Per-input 2-D output width: combiner reduces hotness; no combiner
-    flattens it (the reference reshapes every mp output to [batch, -1],
-    ``dist_model_parallel.py:297,307``). ``enc`` is the input's routing
-    descriptor: ``("d", hotness)`` for dense, ``("r", capacity)`` for
-    static-capacity ragged (always combined, so width is the table width)."""
-    w = int(config["output_dim"])
-    if enc[0] == "r":
-        return w
-    return w if config.get("combiner") else w * enc[1]
-
-
-def _block_len(enc, b: int) -> int:
-    """Ints a routed input contributes to one all-to-all block: a dense
-    ``[b, h]`` flattens to ``b*h``; a ragged feature travels as its padded
-    values plus per-row lengths, ``cap + b``."""
-    return enc[1] * b if enc[0] == "d" else enc[1] + b
+# Marks exchange-layout cells covered by a multi-cell content array placed at
+# an earlier slot (no-combiner multi-hot features span `hotness` slots).
+_SPANNED = object()
 
 
 def _wkey(width: int) -> str:
@@ -222,6 +220,8 @@ class DistributedEmbedding:
         self.phys_w: Dict[int, int] = {w: ps.phys_width(w) for w in widths}
         self.rows_cap = {w: ps.align_rows(self.rows_cap[w], w)
                          for w in widths}
+        # exchange plans are (input signature, batch)-dependent; built lazily
+        self._plan_cache: Dict[tuple, plan_mod.ExchangePlan] = {}
 
     # ------------------------------------------------------------------ params
 
@@ -284,21 +284,56 @@ class DistributedEmbedding:
         inits off-accelerator for the same reason, ``embedding.py:28-38``),
         and on multi-host meshes each process initializes only its
         addressable shards.
+
+        Fast path: a width group whose tables ALL use the default initializer
+        (an elementwise uniform) is generated as ONE partitioned
+        ``jax.random.uniform`` over the whole ``[world, phys_cap, phys_w]``
+        slab — one small compile regardless of table count (the per-table
+        path compiles O(tables) HLO per device and dominated colossal-scale
+        startup). Layout padding rows/lanes then hold random values instead
+        of zeros; nothing reads them (forward clips ids in-table, checkpoint
+        paths slice exact row ranges).
         """
         keys = jax.random.split(key, self.world_size)
 
+        default_widths = {
+            w: all(c.get("embeddings_initializer") is None
+                   for cfgs in self.strategy.local_configs_list
+                   for c in cfgs if int(c["output_dim"]) == w)
+            for w in self.widths}
+
+        def fast_uniform(w, sharding=None):
+            shape = (self.world_size, self.phys_cap[w], self.phys_w[w])
+            fn = jax.jit(
+                lambda k: jax.random.uniform(k, shape, dtype,
+                                             minval=-0.05, maxval=0.05),
+                **({"out_shardings": sharding} if sharding is not None else {}))
+            return fn(jax.random.fold_in(key, w))
+
         if mesh is None:
-            def build():
-                out = {}
-                for w in self.widths:
-                    out[_wkey(w)] = jnp.stack([
-                        self._init_rank_width(keys[r], r, w, dtype)
-                        for r in range(self.world_size)])
-                return out
-            return jax.jit(build)()
+            out = {}
+            slow = [w for w in self.widths if not default_widths[w]]
+            for w in self.widths:
+                if default_widths[w]:
+                    out[_wkey(w)] = fast_uniform(w)
+            if slow:
+                def build():
+                    return {
+                        _wkey(w): jnp.stack([
+                            self._init_rank_width(keys[r], r, w, dtype)
+                            for r in range(self.world_size)])
+                        for w in slow}
+                out.update(jax.jit(build)())
+            return out
 
         out = {}
         for w in self.widths:
+            if default_widths[w]:
+                sharding = jax.sharding.NamedSharding(
+                    mesh, jax.sharding.PartitionSpec(self.axis_name))
+                out[_wkey(w)] = fast_uniform(w, sharding)
+                continue
+
             def init_shard(dev, r0, r1, w=w):
                 def build(ks):
                     return jnp.stack([
@@ -357,7 +392,8 @@ class DistributedEmbedding:
         ``[batch, hotness]``, :class:`~..ops.embedding_lookup.Ragged` inputs
         become ``("r", values [cap], lengths [batch])`` records. Returns
         ``(entries, encs, was_1d)`` where ``encs[i]`` is the static routing
-        descriptor (see :func:`_out_width`) and ``was_1d`` tracks 1-D dense
+        descriptor (``("d", hotness)`` / ``("r", capacity)``, the key the
+        exchange plan is built from) and ``was_1d`` tracks 1-D dense
         inputs so local lookups preserve the reference's ``[batch, width]``
         output shape."""
         if len(inputs) != self.strategy.num_inputs:
@@ -428,13 +464,15 @@ class DistributedEmbedding:
         return out
 
     def pack_mp_inputs(self, inputs, dtype=None, mesh=None,
-                       hots: Optional[Sequence[int]] = None,
+                       hots: Optional[Sequence[Any]] = None,
                        local_batch: Optional[int] = None) -> MpInputs:
-        """Pack per-feature global-batch id arrays into :class:`MpInputs`.
+        """Pack per-feature global-batch ids into :class:`MpInputs`.
 
-        ``inputs[i]`` is ``[global_batch]`` or ``[global_batch, hotness]`` for
-        global input ``i``, ordered by data-parallel shard (shard ``s`` owns
-        rows ``s*b:(s+1)*b``) — the natural order of a global batch. Host-side
+        ``inputs[i]`` is ``[global_batch]`` / ``[global_batch, hotness]``
+        dense ids, or a :class:`~..ops.embedding_lookup.Ragged` over the
+        *global* batch (values ``[cap]``, row_splits ``[global_batch+1]``),
+        ordered by data-parallel shard (shard ``s`` owns rows
+        ``s*b:(s+1)*b``) — the natural order of a global batch. Host-side
         numpy; with ``mesh`` given the packed array is laid out sharded over
         ``axis_name`` so each device receives only its own block.
 
@@ -442,24 +480,38 @@ class DistributedEmbedding:
         ranks own (reference ``examples/dlrm/main.py:166-176`` reads only the
         local tables' ``cat_*.bin``); entries for other ranks' features may be
         ``None`` — their packed blocks live on other processes' devices. In
-        that case pass ``hots`` (per-input hotness of ALL inputs) and, if
+        that case pass ``hots`` (per-input encoding of ALL inputs: an int
+        hotness for dense, ``("r", per_shard_capacity)`` for ragged) and, if
         every entry is None, ``local_batch`` too: the packed layout must be
         identical on every process, so it cannot be inferred from local
         arrays alone.
+
+        Ragged per-shard capacity: by default a global-batch ``Ragged`` input
+        is packed with per-shard capacity equal to its *global* capacity
+        (always safe; padded). Pass ``("r", cap)`` in ``hots`` to use a
+        tighter static capacity — it must be the same on every process and
+        every batch, and each shard's actual nnz must fit it (checked).
 
         Args:
           dtype: id dtype of the packed block; default promotes like the dp
             path (int64 if any provided array is int64, else int32).
         """
         world = self.world_size
-        if any(isinstance(x, Ragged) for x in inputs):
-            raise NotImplementedError(
-                "pack_mp_inputs takes dense ids; ragged features currently "
-                "route through the dp-input path")
-        arrs = [None if x is None else np.asarray(x) for x in inputs]
+        arrs = []
+        for x in inputs:
+            if x is None or isinstance(x, Ragged):
+                arrs.append(x)
+            else:
+                a = np.asarray(x)
+                arrs.append(a[:, None] if a.ndim == 1 else a)
         if len(arrs) != self.strategy.num_inputs:
             raise ValueError(
                 f"Expected {self.strategy.num_inputs} inputs, got {len(arrs)}")
+
+        def glen(a):
+            return (a.row_splits.shape[0] - 1 if isinstance(a, Ragged)
+                    else a.shape[0])
+
         some = next((a for a in arrs if a is not None), None)
         if some is None:
             if local_batch is None or hots is None:
@@ -469,7 +521,7 @@ class DistributedEmbedding:
                     "processes)")
             b = int(local_batch)
         else:
-            gb = some.shape[0]
+            gb = glen(some)
             if gb % world:
                 raise ValueError(
                     f"Global batch {gb} not divisible by world size {world}")
@@ -477,39 +529,75 @@ class DistributedEmbedding:
             if local_batch is not None and int(local_batch) != b:
                 raise ValueError(
                     f"local_batch={local_batch} contradicts inputs ({b})")
-        if dtype is None:
-            dtype = (jnp.int64 if any(a is not None and a.dtype == np.int64
-                                      for a in arrs) else jnp.int32)
-        arrs = [None if a is None else (a[:, None] if a.ndim == 1 else a)
-                for a in arrs]
-        if hots is None:
-            if any(a is None for a in arrs):
-                raise ValueError(
-                    "pack_mp_inputs with None entries needs explicit hots= "
-                    "(hotness of every input must be globally known)")
-            hots = tuple(int(a.shape[1]) for a in arrs)
-        else:
-            hots = tuple(int(h) for h in hots)
             for i, a in enumerate(arrs):
-                if a is not None and a.shape[1] != hots[i]:
+                if a is not None and glen(a) != gb:
                     raise ValueError(
-                        f"Input {i} hotness {a.shape[1]} != hots[{i}]={hots[i]}")
-        l_max = max(max(b * sum(hots[i] for i in ids)
-                        for ids in self.strategy.input_ids_list), 1)
-        rows = []
-        for ids in self.strategy.input_ids_list:
-            parts = []
-            for i in ids:
-                if arrs[i] is None:
-                    parts.append(np.zeros((world, b * hots[i]), np.int32))
-                else:
-                    parts.append(arrs[i].reshape(world, b * hots[i]))
-            blk = (np.concatenate(parts, axis=1) if parts
-                   else np.zeros((world, 0), np.int32))
-            if blk.shape[1] < l_max:
-                blk = np.pad(blk, ((0, 0), (0, l_max - blk.shape[1])))
-            rows.append(blk)
-        packed_np = np.stack(rows).astype(jnp.dtype(dtype))  # [dest, src, l_max]
+                        f"Input {i} batch {glen(a)} != {gb}")
+        def is64(a):
+            if isinstance(a, Ragged):
+                # same promotion rule as the dp path's _normalize_inputs
+                return any(np.asarray(x).dtype == np.int64
+                           for x in (a.values, a.row_splits))
+            return a.dtype == np.int64
+
+        if dtype is None:
+            dtype = (jnp.int64 if any(a is not None and is64(a) for a in arrs)
+                     else jnp.int32)
+
+        # per-input encodings, hots-validated
+        if hots is None and any(a is None for a in arrs):
+            raise ValueError(
+                "pack_mp_inputs with None entries needs explicit hots= "
+                "(the encoding of every input must be globally known)")
+        encs = []
+        for i, a in enumerate(arrs):
+            if hots is not None:
+                h = hots[i]
+                enc = (("r", int(h[1])) if isinstance(h, (tuple, list))
+                       else ("d", int(h)))
+            elif isinstance(a, Ragged):
+                enc = ("r", int(a.capacity))
+            else:
+                enc = ("d", int(a.shape[1]))
+            if a is not None:
+                if isinstance(a, Ragged) != (enc[0] == "r"):
+                    raise ValueError(
+                        f"Input {i} encoding {enc} does not match the "
+                        f"provided value type")
+                if enc[0] == "d" and a.shape[1] != enc[1]:
+                    raise ValueError(
+                        f"Input {i} hotness {a.shape[1]} != hots[{i}]={enc[1]}")
+            encs.append(enc)
+
+        plan = self._get_plan(encs, b)
+        np_dtype = np.dtype(jnp.dtype(dtype).name)
+        packed_np = np.zeros((world, world, plan.l_max), np_dtype)
+        for inst in plan.instances:
+            a = arrs[inst.input_id]
+            if a is None:
+                continue
+            g = plan.groups[inst.group]
+            p0 = g.goff + inst.slot0 * g.blen
+            span = inst.num_slots * g.blen
+            if g.kind == "r":
+                values = np.asarray(a.values)
+                splits = np.asarray(a.row_splits)
+                cap = g.hot
+                for s in range(world):
+                    lo, hi = int(splits[s * b]), int(splits[(s + 1) * b])
+                    if hi - lo > cap:
+                        raise ValueError(
+                            f"Input {inst.input_id}: shard {s} nnz {hi - lo} "
+                            f"exceeds per-shard capacity {cap}")
+                    blk = np.zeros(cap + b, np_dtype)
+                    blk[:hi - lo] = values[lo:hi]
+                    blk[cap:] = np.diff(splits[s * b:(s + 1) * b + 1])
+                    packed_np[inst.rank, s, p0:p0 + span] = blk
+            else:
+                for s in range(world):
+                    shard = a[s * b:(s + 1) * b]
+                    flat = (shard.T if inst.transposed else shard).reshape(-1)
+                    packed_np[inst.rank, s, p0:p0 + span] = flat
         if mesh is not None:
             sharding = jax.sharding.NamedSharding(
                 mesh, jax.sharding.PartitionSpec(self.axis_name))
@@ -519,7 +607,8 @@ class DistributedEmbedding:
                 packed_np.shape, sharding, lambda idx: packed_np[idx])
         else:
             packed = jnp.asarray(packed_np)
-        return MpInputs(packed=packed, hots=hots, local_batch=b)
+        hots_out = tuple(h if k == "d" else ("r", h) for k, h in encs)
+        return MpInputs(packed=packed, hots=hots_out, local_batch=b)
 
     def _lookup_local(self, params: EmbedParams, rank: int,
                       inputs: Sequence[jax.Array],
@@ -604,30 +693,14 @@ class DistributedEmbedding:
                     raise ValueError("All inputs must share the batch dimension")
             comm_dtype = (entries[0][1].dtype if isinstance(entries[0], tuple)
                           else entries[0].dtype)
+            plan = self._get_plan(encs, b)
 
             # --- dp -> mp id exchange --------------------------------------
-            # Block for dest rank r: its inputs flattened and concatenated
-            # (reference :273-282), padded to the max block length. Ragged
-            # features contribute [values(cap), lengths(b)].
-            block_lens = [sum(_block_len(encs[i], b) for i in ids)
-                          for ids in self.strategy.input_ids_list]
-            l_max = max(max(block_lens), 1)
-            blocks = []
-            for ids in self.strategy.input_ids_list:
-                parts = []
-                for i in ids:
-                    e = entries[i]
-                    if isinstance(e, tuple):
-                        parts.extend([e[1], e[2]])
-                    else:
-                        parts.append(e.reshape(-1))
-                blk = (jnp.concatenate(parts) if parts
-                       else jnp.zeros((0,), comm_dtype))
-                if blk.shape[0] < l_max:
-                    blk = jnp.concatenate(
-                        [blk, jnp.zeros((l_max - blk.shape[0],), comm_dtype)])
-                blocks.append(blk)
-            ids_send = jnp.stack(blocks)  # [world, l_max]
+            # Blocks use the rank-uniform group-region layout (plan.py); the
+            # reference pads to the max per-rank split instead
+            # (dist_model_parallel.py:273-282) — same idea, but static
+            # regions let the lookup below run without per-rank branches.
+            ids_send = self._build_send_blocks(plan, entries, comm_dtype)
             ids_recv = lax.all_to_all(ids_send, self.axis_name, 0, 0, tiled=True)
         else:
             # --- model-parallel input: this rank already holds the global
@@ -641,88 +714,198 @@ class DistributedEmbedding:
                 raise ValueError(
                     f"Expected {self.strategy.num_inputs} hotness entries, "
                     f"got {len(inputs.hots)}")
-            encs = [("d", int(h)) for h in inputs.hots]
+            encs = [(("r", int(h[1])) if isinstance(h, (tuple, list))
+                     else ("d", int(h))) for h in inputs.hots]
             b = int(inputs.local_batch)
+            plan = self._get_plan(encs, b)
             ids_recv = inputs.packed
             if ids_recv.ndim == 3:  # [1, world, l_max] shard inside shard_map
                 ids_recv = ids_recv.reshape(ids_recv.shape[-2],
                                             ids_recv.shape[-1])
+            if ids_recv.shape != (world, plan.l_max):
+                raise ValueError(
+                    f"MpInputs packed shape {ids_recv.shape} does not match "
+                    f"the plan layout {(world, plan.l_max)}; repack with "
+                    "pack_mp_inputs() from this DistributedEmbedding")
             if not jnp.issubdtype(ids_recv.dtype, jnp.integer):
                 ids_recv = ids_recv.astype(jnp.int32)
 
-        # --- rank-specialized local lookup (lax.switch over mesh position) --
-        out_widths_list = [
-            [_out_width(self._input_config(r, j), encs[i])
-             for j, i in enumerate(ids)]
-            for r, ids in enumerate(self.strategy.input_ids_list)]
-        s_max = max(max((sum(ws) for ws in out_widths_list), default=1), 1)
-
-        def branch(rank, params_, recv):
-            ids = self.strategy.input_ids_list[rank]
-            parsed, pos = [], 0
-            for i in ids:
-                parsed.append(self._parse_block(recv, pos, encs[i], b))
-                pos += _block_len(encs[i], b)
-            outs = self._lookup_local(params_, rank, parsed, flatten_2d=True)
-            dt = self.compute_dtype or next(iter(params_.values())).dtype
-            if outs:
-                # pre-comm mixed-precision cast (reference :300): lookups and
-                # combiners ran in param dtype; the exchange rides compute_dtype
-                cat = jnp.concatenate(outs, axis=1).astype(dt)
-            else:
-                # keep branch output types identical across ranks: match the
-                # param dtype and mark the constant device-varying
-                cat = _pvary(jnp.zeros((world * b, 0), dt), self.axis_name)
-            pad = s_max - cat.shape[1]
-            if pad:
-                cat = jnp.concatenate(
-                    [cat, _pvary(jnp.zeros((world * b, pad), cat.dtype),
-                                    self.axis_name)], axis=1)
-            return cat
-
-        my_rank = lax.axis_index(self.axis_name)
-        mp_out = lax.switch(
-            my_rank,
-            [functools.partial(branch, r) for r in range(world)],
-            params, ids_recv)  # [world*b, s_max]
+        # --- rank-uniform local lookup (plan-tensor-driven) ----------------
+        mp_out = self._plan_lookup(plan, params, ids_recv)  # [world, b, s_max]
 
         # --- mp -> dp output exchange --------------------------------------
-        dp_recv = lax.all_to_all(
-            mp_out.reshape(world, b, s_max), self.axis_name, 0, 0, tiled=True)
+        dp_recv = lax.all_to_all(mp_out, self.axis_name, 0, 0, tiled=True)
         # dp_recv[r] = this rank's batch as computed by source rank r.
 
-        # --- unpack (rank-uniform), reorder, concat column slices ----------
+        # --- unpack (static slices), reorder, concat column slices ---------
         worker_order: List[jax.Array] = []
-        for r, widths in enumerate(out_widths_list):
-            pos = 0
-            for w in widths:
-                worker_order.append(
-                    lax.slice(dp_recv, (r, 0, pos), (r + 1, b, pos + w)
-                              ).reshape(b, w))
-                pos += w
+        for inst in plan.instances:
+            g = plan.groups[inst.group]
+            c0 = g.col + inst.slot0 * g.width
+            ow = inst.num_slots * g.width
+            worker_order.append(
+                lax.slice(dp_recv, (inst.rank, 0, c0),
+                          (inst.rank + 1, b, c0 + ow)).reshape(b, ow))
         result = [worker_order[i] for i in self.strategy.rev_global_input_ids]
         for start, end in self.strategy.sliced_out_ranges:
             result[start:end] = [jnp.concatenate(result[start:end], axis=-1)]
-        return result, ("dist", ids_recv, encs, b, out_widths_list, s_max)
+        return result, ("dist", ids_recv, tuple(encs), b)
 
-    def _parse_block(self, recv, pos: int, enc, b: int):
-        """Extract one routed input from a ``[world, l_max]`` exchange block
-        starting at ``pos``: dense → ``[world*b, h]``; ragged → the
-        ``("r", values [world, cap], lengths [world, b])`` record."""
-        world = recv.shape[0]
-        if enc[0] == "d":
-            h = enc[1]
-            seg = lax.slice(recv, (0, pos), (world, pos + b * h))
-            return seg.reshape(world * b, h)
-        cap = enc[1]
-        values = lax.slice(recv, (0, pos), (world, pos + cap))
-        lengths = lax.slice(recv, (0, pos + cap), (world, pos + cap + b))
-        return ("r", values, lengths)
+    # ------------------------------------------------- plan-driven executor
 
-    def _input_config(self, rank: int, j: int):
-        """Config of the table serving the j-th input routed to ``rank``."""
-        m = self.strategy.local_map_list[rank][j]
-        return self.strategy.local_configs_list[rank][m]
+    def _get_plan(self, encs, b: int) -> plan_mod.ExchangePlan:
+        key = (tuple(encs), int(b))
+        p = self._plan_cache.get(key)
+        if p is None:
+            p = plan_mod.build_plan(self.strategy, self.row_offsets_list,
+                                    encs, int(b))
+            self._plan_cache[key] = p
+        return p
+
+    def _plan_row(self, arr: np.ndarray, my) -> jax.Array:
+        """This device's row of a ``[world, n]`` plan tensor. The tensor is a
+        baked program constant; indexing it by ``lax.axis_index`` is what
+        replaces rank-specialized branches."""
+        c = _pvary(jnp.asarray(arr), self.axis_name)
+        return lax.dynamic_index_in_dim(c, my, keepdims=False)
+
+    def _assemble_cells(self, plan, fill, dead_shape, full_shape, dtype,
+                        axis: int) -> jax.Array:
+        """Shared layout assembly for the forward id blocks and backward grad
+        blocks: place each instance's content at its (rank, group, slot0)
+        cell — content spans all ``num_slots`` cells of a multi-slot
+        instance — fill dead cells with zeros, concatenate in group/slot
+        layout order per destination rank, and stack over ranks.
+
+        Args:
+          fill: ``fill(inst) -> array`` — the instance's content in layout
+            form (ids flattened / grad block).
+          dead_shape: ``dead_shape(group) -> shape`` of one dead cell.
+          full_shape: shape of an all-dead destination row (no-groups edge).
+          dtype: content dtype (zeros match it).
+          axis: concat axis of the per-destination parts.
+        """
+        cells = [[[None] * g.n for g in plan.groups]
+                 for _ in range(self.world_size)]
+        for inst in plan.instances:
+            row = cells[inst.rank][inst.group]
+            row[inst.slot0] = fill(inst)
+            for k in range(1, inst.num_slots):
+                row[inst.slot0 + k] = _SPANNED
+        zeros_cache: Dict[tuple, jax.Array] = {}
+
+        def dead(shape):
+            z = zeros_cache.get(shape)
+            if z is None:
+                z = _pvary(jnp.zeros(shape, dtype), self.axis_name)
+                zeros_cache[shape] = z
+            return z
+
+        blocks = []
+        for dest in range(self.world_size):
+            parts = []
+            for gi, g in enumerate(plan.groups):
+                for k in range(g.n):
+                    c = cells[dest][gi][k]
+                    if c is _SPANNED:
+                        continue
+                    parts.append(dead(dead_shape(g)) if c is None else c)
+            blocks.append(jnp.concatenate(parts, axis=axis) if parts
+                          else dead(full_shape))
+        return jnp.stack(blocks)
+
+    def _build_send_blocks(self, plan, entries, comm_dtype) -> jax.Array:
+        """Assemble the dp->mp id blocks ``[world, l_max]`` in the plan's
+        group-region layout. Dead (padding) slots send zeros; a no-combiner
+        multi-hot feature sends its ids column-major so each of its hotness-1
+        slots stays contiguous."""
+
+        def fill(inst):
+            e = entries[inst.input_id]
+            if isinstance(e, tuple):  # ("r", values [cap], lengths [b])
+                return jnp.concatenate(
+                    [e[1].astype(comm_dtype), e[2].astype(comm_dtype)])
+            if inst.transposed:
+                return e.T.reshape(-1)  # spans num_slots cells
+            return e.reshape(-1)
+
+        return self._assemble_cells(
+            plan, fill, dead_shape=lambda g: (g.blen,),
+            full_shape=(plan.l_max,), dtype=comm_dtype, axis=0)
+
+    def _ragged_decode(self, g, b: int, region, rows, roff, valid):
+        """Decode one ragged group region ``[world, n*(cap+b)]`` into
+        ``(values, lengths, seg, grow, counts)``, all ``[world, n, ...]``.
+        Dead slots get zero lengths, so every position routes to the dropped
+        segment ``b``."""
+        world = self.world_size
+        r3 = region.reshape(world, g.n, g.blen)
+        values = r3[:, :, :g.hot]
+        lengths = r3[:, :, g.hot:] * valid[None, :, None].astype(r3.dtype)
+        zero = jnp.zeros((world, g.n, 1), lengths.dtype)
+        splits = jnp.concatenate([zero, jnp.cumsum(lengths, axis=2)], axis=2)
+        seg = jax.vmap(jax.vmap(
+            functools.partial(ragged_row_ids, capacity=g.hot)))(splits)
+        grow = (jnp.clip(values, 0, (rows - 1)[None, :, None])
+                + roff[None, :, None])
+        counts = jnp.maximum(lengths, 1)
+        return values, lengths, seg, grow, counts
+
+    @staticmethod
+    def _ragged_scatter_idx(g, b: int, world: int, seg) -> jax.Array:
+        """Flattened per-value output index into a ``[world*n*(b+1), w]``
+        segment buffer; row ``b`` of each slot is the dropped sentinel."""
+        s_ix = jnp.arange(world, dtype=seg.dtype)[:, None, None]
+        f_ix = jnp.arange(g.n, dtype=seg.dtype)[None, :, None]
+        return (s_ix * g.n + f_ix) * (b + 1) + seg
+
+    def _plan_lookup(self, plan, params: EmbedParams, ids_recv) -> jax.Array:
+        """All local lookups, one rank-uniform program: per group, one region
+        reshape, one slab gather, one combine. Returns ``[world, b, s_max]``
+        in ``compute_dtype`` (the pre-comm mixed-precision cast, reference
+        ``dist_model_parallel.py:300``). Dead slots produce garbage columns
+        that no consumer ever slices."""
+        world = self.world_size
+        b = plan.b
+        my = lax.axis_index(self.axis_name)
+        pdt = next(iter(params.values())).dtype
+        sections = []
+        for gi, g in enumerate(plan.groups):
+            slab = params[_wkey(g.width)]
+            rows = self._plan_row(plan.rows[gi], my)
+            roff = self._plan_row(plan.roff[gi], my)
+            mean = self._plan_row(plan.mean[gi], my)
+            region = lax.slice(ids_recv, (0, g.goff),
+                               (world, g.goff + g.n * g.blen))
+            if g.kind == "d":
+                ids = region.reshape(world, g.n, b, g.hot)
+                grow = (jnp.clip(ids, 0, (rows - 1)[None, :, None, None])
+                        + roff[None, :, None, None])
+                gath = ps.packed_gather(slab, grow, g.width)
+                red = jnp.sum(gath, axis=3)  # [world, n, b, w]
+                if g.hot > 1:
+                    red = jnp.where(mean[None, :, None, None] > 0,
+                                    red / g.hot, red)
+            else:
+                _, _, seg, grow, counts = self._ragged_decode(
+                    g, b, region, rows, roff,
+                    self._plan_row(plan.valid[gi], my))
+                gath = ps.packed_gather(slab, grow, g.width)  # [w, n, cap, ww]
+                sidx = self._ragged_scatter_idx(g, b, world, seg)
+                buf = jnp.zeros((world * g.n * (b + 1), g.width), gath.dtype)
+                buf = buf.at[sidx.reshape(-1)].add(
+                    gath.reshape(-1, g.width))
+                red = buf.reshape(world, g.n, b + 1, g.width)[:, :, :b, :]
+                red = jnp.where(mean[None, :, None, None] > 0,
+                                red / counts[..., None].astype(red.dtype),
+                                red)
+            sections.append(
+                red.transpose(0, 2, 1, 3).reshape(world, b, g.n * g.width))
+        mp = (jnp.concatenate(sections, axis=2) if sections
+              else _pvary(jnp.zeros((world, b, plan.s_max), pdt),
+                          self.axis_name))
+        dt = self.compute_dtype
+        return mp.astype(dt) if dt is not None else mp
 
     # ------------------------------------------------------ sparse backward
 
@@ -843,14 +1026,15 @@ class DistributedEmbedding:
             return self._rank_sparse_update(
                 0, params, opt_state, inputs, grads, optimizer, lr, scale)
 
-        _, ids_recv, encs, b, out_widths_list, s_max = residuals
+        _, ids_recv, encs, b = residuals
         world = self.world_size
+        plan = self._get_plan(list(encs), b)
 
         # Invert the column-slice collapse then the input-order reorder,
         # rebuilding worker order. In fully-expanded coordinates, output entry
         # e has width worker_widths[rev[e]]; input i owns the next
         # slices-per-table[table(i)] expanded entries.
-        worker_widths = [w for ws in out_widths_list for w in ws]
+        worker_widths = [plan.out_width(inst) for inst in plan.instances]
         rev = self.strategy.rev_global_input_ids
         expanded: List[Optional[jax.Array]] = []
         e = 0
@@ -869,44 +1053,94 @@ class DistributedEmbedding:
         for idx, g in enumerate(expanded):
             worker_grads[rev[idx]] = g
 
-        # Pack per source rank, pad to s_max, reverse the output all-to-all.
+        # Pack [world, b, s_max] in the plan's column layout and reverse the
+        # output all-to-all (autodiff of the forward exchange would insert the
+        # same collective; reference rides Horovod's registered alltoall grad).
         out_dtype = (out_grads[0].dtype if out_grads
                      else next(iter(params.values())).dtype)
-        rows, k2 = [], 0
-        for ws in out_widths_list:
-            cat = (jnp.concatenate(worker_grads[k2:k2 + len(ws)], axis=1)
-                   if ws else _pvary(jnp.zeros((b, 0), out_dtype),
-                                        self.axis_name))
-            k2 += len(ws)
-            pad = s_max - cat.shape[1]
-            if pad:
-                cat = jnp.concatenate(
-                    [cat, _pvary(jnp.zeros((b, pad), cat.dtype),
-                                    self.axis_name)], axis=1)
-            rows.append(cat)
-        packed = jnp.stack(rows)  # [world, b, s_max]
+        grads_by_worker = dict(zip(plan.instances, worker_grads))
+        packed = self._assemble_cells(
+            plan,
+            # a multi-slot instance's grad [b, num_slots*w] spans its columns
+            fill=lambda inst: grads_by_worker[inst].astype(out_dtype),
+            dead_shape=lambda g: (b, g.width),
+            full_shape=(b, plan.s_max), dtype=out_dtype,
+            axis=1)  # [world, b, s_max]
         mp_grad = lax.all_to_all(packed, self.axis_name, 0, 0, tiled=True)
-        mp_grad = mp_grad.reshape(world * b, s_max)
 
-        # Rank-specialized update (same switch pattern as the forward).
-        def branch(rank, params_, state_, recv, grad):
-            parsed, pos = [], 0
-            for i in self.strategy.input_ids_list[rank]:
-                parsed.append(self._parse_block(recv, pos, encs[i], b))
-                pos += _block_len(encs[i], b)
-            gslices, gpos = [], 0
-            for w in out_widths_list[rank]:
-                gslices.append(lax.slice(grad, (0, gpos),
-                                         (world * b, gpos + w)))
-                gpos += w
-            return self._rank_sparse_update(
-                rank, params_, state_, parsed, gslices, optimizer, lr, scale)
+        # Rank-uniform sparse update: per group, rebuild the id stream from
+        # the forward's residual block and expand slot cotangents to per-id
+        # update rows; per width, one optimizer scatter.
+        my = lax.axis_index(self.axis_name)
+        per_width: Dict[str, List] = {}
+        for gi, g in enumerate(plan.groups):
+            rows = self._plan_row(plan.rows[gi], my)
+            roff = self._plan_row(plan.roff[gi], my)
+            valid = self._plan_row(plan.valid[gi], my)
+            mean = self._plan_row(plan.mean[gi], my)
+            sent = self.rows_cap[g.width]  # dropped-row sentinel (logical)
+            region = lax.slice(ids_recv, (0, g.goff),
+                               (world, g.goff + g.n * g.blen))
+            gsl = lax.slice(mp_grad, (0, 0, g.col),
+                            (world, b, g.col + g.n * g.width))
+            gsl = gsl.reshape(world, b, g.n, g.width).transpose(0, 2, 1, 3)
+            if g.kind == "d":
+                ids4 = region.reshape(world, g.n, b, g.hot)
+                # out-of-range ids were clipped in the forward (safety net)
+                # but are dropped here: a bad id trains nothing (see module
+                # docstring contract)
+                ok = ((ids4 >= 0) & (ids4 < rows[None, :, None, None])
+                      & (valid[None, :, None, None] > 0))
+                ids = jnp.where(ok, ids4 + roff[None, :, None, None], sent)
+                gb = gsl
+                if g.hot > 1:
+                    gb = jnp.where(mean[None, :, None, None] > 0,
+                                   gsl / g.hot, gsl)
+                vals = jnp.broadcast_to(
+                    gb[:, :, :, None, :],
+                    (world, g.n, b, g.hot, g.width))
+            else:
+                values, _, seg, _, counts = self._ragged_decode(
+                    g, b, region, rows, roff, valid)
+                sidx = self._ragged_scatter_idx(g, b, world, seg)
+                gpad = jnp.concatenate(
+                    [gsl, _pvary(jnp.zeros((world, g.n, 1, g.width),
+                                           gsl.dtype), self.axis_name)],
+                    axis=2)  # [world, n, b+1, w]
+                vals = jnp.take(gpad.reshape(-1, g.width), sidx.reshape(-1),
+                                axis=0).reshape(world, g.n, g.hot, g.width)
+                cpad = jnp.concatenate(
+                    [counts, jnp.ones((world, g.n, 1), counts.dtype)], axis=2)
+                cval = jnp.take(cpad.reshape(-1), sidx.reshape(-1)
+                                ).reshape(world, g.n, g.hot)
+                vals = jnp.where(mean[None, :, None, None] > 0,
+                                 vals / cval[..., None].astype(vals.dtype),
+                                 vals)
+                ok = ((seg < b) & (values >= 0)
+                      & (values < rows[None, :, None])
+                      & (valid[None, :, None] > 0))
+                ids = jnp.where(ok, values + roff[None, :, None], sent)
+            per_width.setdefault(_wkey(g.width), []).append(
+                (ids.reshape(-1), vals.reshape(-1, g.width), g.width))
 
-        my_rank = lax.axis_index(self.axis_name)
-        return lax.switch(
-            my_rank,
-            [functools.partial(branch, r) for r in range(world)],
-            params, opt_state, ids_recv, mp_grad)
+        new_params = dict(params)
+        new_state = dict(opt_state) if isinstance(opt_state, dict) else opt_state
+        for k in sorted(per_width):
+            tris = per_width[k]
+            w = tris[0][2]
+            ids = jnp.concatenate([t[0] for t in tris])
+            vals = jnp.concatenate([t[1] for t in tris]) * scale
+            # lane-expand to physical rows: the scatter (and any dedup in the
+            # optimizer) runs on full-tile rows; lane-disjoint placement keeps
+            # per-logical-row semantics exact (ops/packed_slab.py)
+            phys_ids, pvals = ps.expand_update_rows(vals, ids, w)
+            slab = new_params[k]
+            st = new_state[k] if isinstance(new_state, dict) else new_state
+            slab, st = optimizer.apply_rows(slab, st, phys_ids, pvals, lr)
+            new_params[k] = slab
+            if isinstance(new_state, dict):
+                new_state[k] = st
+        return new_params, new_state
 
     # ------------------------------------------------------------- checkpoint
 

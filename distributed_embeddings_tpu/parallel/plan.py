@@ -1,0 +1,177 @@
+"""Static exchange plans for the rank-uniform executor.
+
+The reference executes per-rank heterogeneity as per-rank *programs*: each
+Horovod process builds only its local layers and runs its own Python loop
+over them (``dist_model_parallel.py:261-311``). The first TPU port of that
+idea expressed the same thing as ``lax.switch`` over rank-specialized
+branches — but SPMD compiles every branch on every device, so HLO grew as
+O(world x tables) and colossal-scale models (2002 tables,
+``config_v3.py:107-121``) became a compile-time cliff.
+
+This module makes per-rank heterogeneity *data* instead of *program*. The
+id-exchange block and the output-exchange row are laid out as a sequence of
+**group regions at static offsets that are identical on every rank**:
+
+* a *dense group* ``(width w, hotness h)`` holds ``n`` slots, each slot one
+  combiner lookup: ``b*h`` ids in the block, ``w`` output columns;
+* a *ragged group* ``(width w, capacity c)`` holds ``n`` slots, each slot one
+  static-capacity CSR feature: ``c`` values + ``b`` lengths in the block,
+  ``w`` output columns;
+* ``n`` is the max slot count over ranks — ranks with fewer tables of that
+  shape pad with dead slots (zero ids in, never-read columns out).
+
+What *differs* per rank — which table a slot reads (row count, slab row
+offset), its combiner, whether the slot is live — is carried in small
+``[world, n]`` plan tensors indexed by ``lax.axis_index`` at run time. One
+compiled program serves every mesh position: per group, ONE reshape of the
+block region, ONE slab gather, ONE reduction — O(#groups) heavy HLO ops
+total, independent of world size and table count.
+
+A multi-hot feature *without* a combiner ([batch, h] ids -> [batch, h*w]
+activations) is expressed as ``h`` consecutive hotness-1 slots; its ids
+travel column-major ([h, b]) so each slot's ids stay contiguous.
+
+Plans depend on the per-input encodings and the local batch size, both known
+only at trace time, so :class:`~.dist_embedding.DistributedEmbedding` builds
+them lazily and caches by ``(encodings, batch)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupSpec:
+    """One rank-uniform region of the exchange layout."""
+
+    kind: str    # "d" dense | "r" ragged
+    width: int   # per-slot output width (the column-slice width for slices)
+    hot: int     # dense: ids per batch row per slot; ragged: value capacity
+    n: int       # slots (max over ranks; shorter ranks are padded)
+    blen: int    # ints one slot occupies per source block
+    goff: int    # region start within the [l_max] id block
+    col: int     # region start within the [s_max] output row
+
+
+@dataclasses.dataclass(frozen=True)
+class InstanceSpec:
+    """One routed input on one rank (worker-order entry).
+
+    ``num_slots > 1`` only for no-combiner multi-hot features (one slot per
+    hot position, ids sent column-major)."""
+
+    input_id: int
+    rank: int
+    group: int
+    slot0: int
+    num_slots: int
+
+    @property
+    def transposed(self) -> bool:
+        return self.num_slots > 1
+
+
+@dataclasses.dataclass(frozen=True)
+class ExchangePlan:
+    """Complete static layout + per-rank plan tensors for one input signature.
+
+    Plan arrays are all ``[world, n_g]`` numpy, one per group:
+
+    * ``rows``  — table row count a slot reads (1 for dead slots);
+    * ``roff``  — slot's table row offset inside its width slab;
+    * ``valid`` — 1.0 for live slots, 0.0 for padding (backward routes dead
+      slots' ids to the dropped sentinel);
+    * ``mean``  — 1.0 where the slot's combiner is ``'mean'`` (forward
+      divides the reduced sum, backward divides the cotangent).
+    """
+
+    b: int
+    groups: Tuple[GroupSpec, ...]
+    instances: Tuple[InstanceSpec, ...]
+    l_max: int
+    s_max: int
+    rows: Tuple[np.ndarray, ...]
+    roff: Tuple[np.ndarray, ...]
+    valid: Tuple[np.ndarray, ...]
+    mean: Tuple[np.ndarray, ...]
+
+    def out_width(self, inst: InstanceSpec) -> int:
+        return self.groups[inst.group].width * inst.num_slots
+
+
+def build_plan(strategy, row_offsets_list: Sequence[Sequence[int]],
+               encs: Sequence[tuple], b: int) -> ExchangePlan:
+    """Build the exchange plan for one input signature.
+
+    Args:
+      strategy: a planned :class:`~.strategy.DistEmbeddingStrategy`.
+      row_offsets_list: per-rank per-local-table logical slab row offsets.
+      encs: per global input, ``("d", hotness)`` or ``("r", capacity)``.
+      b: per-shard batch size.
+    """
+    world = strategy.world_size
+    # pass 1: per-rank slot lists per group key, in worker order
+    key_slots: Dict[tuple, List[list]] = {}
+    inst_raw = []  # (input_id, rank, key, slot0, num_slots)
+    for r in range(world):
+        for j, i in enumerate(strategy.input_ids_list[r]):
+            m = strategy.local_map_list[r][j]
+            cfg = strategy.local_configs_list[r][m]
+            w = int(cfg["output_dim"])
+            # row offsets stay < 2^31 in practice: physical slab rows are
+            # HBM-bounded and roff <= phys_rows * pack_factor
+            rows = int(cfg["input_dim"])
+            roff = int(row_offsets_list[r][m])
+            comb = cfg.get("combiner")
+            kind, param = encs[i]
+            if kind == "d":
+                if comb:
+                    key = ("d", w, int(param))
+                    entries = [(rows, roff, 1.0, 1.0 if comb == "mean" else 0.0)]
+                else:
+                    key = ("d", w, 1)
+                    entries = [(rows, roff, 1.0, 0.0)] * int(param)
+            else:
+                key = ("r", w, int(param))
+                entries = [(rows, roff, 1.0, 1.0 if comb == "mean" else 0.0)]
+            slots = key_slots.setdefault(key, [[] for _ in range(world)])
+            inst_raw.append((i, r, key, len(slots[r]), len(entries)))
+            slots[r].extend(entries)
+
+    # pass 2: deterministic group order, cumulative offsets, plan tensors
+    keys = sorted(key_slots)
+    gidx = {k: g for g, k in enumerate(keys)}
+    groups, rows_l, roff_l, valid_l, mean_l = [], [], [], [], []
+    goff = col = 0
+    for k in keys:
+        slots = key_slots[k]
+        kind, w, hp = k
+        n = max(len(s) for s in slots)
+        blen = b * hp if kind == "d" else hp + b
+        groups.append(GroupSpec(kind, w, hp, n, blen, goff, col))
+        goff += n * blen
+        col += n * w
+        rows_a = np.ones((world, n), np.int32)
+        roff_a = np.zeros((world, n), np.int32)
+        val_a = np.zeros((world, n), np.float32)
+        mn_a = np.zeros((world, n), np.float32)
+        for r in range(world):
+            for kk, (tr, to, tv, tm) in enumerate(slots[r]):
+                rows_a[r, kk], roff_a[r, kk] = tr, to
+                val_a[r, kk], mn_a[r, kk] = tv, tm
+        rows_l.append(rows_a)
+        roff_l.append(roff_a)
+        valid_l.append(val_a)
+        mean_l.append(mn_a)
+
+    instances = tuple(
+        InstanceSpec(i, r, gidx[k], s0, ns) for i, r, k, s0, ns in inst_raw)
+    return ExchangePlan(
+        b=b, groups=tuple(groups), instances=instances,
+        l_max=max(goff, 1), s_max=max(col, 1),
+        rows=tuple(rows_l), roff=tuple(roff_l),
+        valid=tuple(valid_l), mean=tuple(mean_l))

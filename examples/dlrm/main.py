@@ -131,6 +131,13 @@ def main(_):
 
     nproc = bootstrap.process_count()
     pid = bootstrap.process_index()
+    if FLAGS.batch_size % world:
+        # world = process_count * local_devices; the len//nproc slicing below
+        # would silently drop the remainder of every global batch — fail
+        # loudly instead (ADVICE r2)
+        raise ValueError(
+            f"--batch_size {FLAGS.batch_size} must be divisible by the "
+            f"global device count {world} ({nproc} processes)")
 
     def prep_cats(cats):
         """Global per-feature id arrays -> the executor's input format."""
