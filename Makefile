@@ -1,7 +1,7 @@
 # Build orchestration (reference: Makefile building the CUDA .so; here the
 # native piece is the C++ data-loader/id-generator shared library).
 
-.PHONY: all native test bench clean pkg
+.PHONY: all native test test-fast bench clean pkg
 
 all: native
 
@@ -10,6 +10,11 @@ native:
 
 test:
 	python -m pytest tests/ -q
+
+# quick tier for tight dev loops: skips @pytest.mark.slow (long compiles,
+# RSS-bounded streaming, 2-process cluster); CI runs the full `test`
+test-fast:
+	python -m pytest tests/ -q -m "not slow"
 
 bench:
 	python bench.py

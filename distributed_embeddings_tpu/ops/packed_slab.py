@@ -26,7 +26,7 @@ Tables with ``width >= 128`` keep their natural layout (``p == 1``).
 from __future__ import annotations
 
 import os
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -165,3 +165,23 @@ def expand_update_rows(vals: jax.Array, logical_ids: jax.Array,
         expanded = jnp.concatenate(
             [expanded, jnp.zeros((vals.shape[0], pad), vals.dtype)], axis=1)
     return logical_ids // p, expanded
+
+
+def expand_touch_mask(logical_ids: jax.Array, width: int,
+                      dtype=jnp.float32) -> Optional[jax.Array]:
+    """Lane-placed 0/1 mask marking which lanes of each expanded update row
+    belong to the addressed *logical* row: ``[n, phys_width]``, 1.0 on the
+    addressed row's ``width`` lanes, 0 elsewhere.
+
+    Needed by stateful-moment optimizers (momentum/Adam): their update is
+    nonzero wherever *state* is nonzero, so after duplicate physical rows are
+    summed, lanes belonging to packed *neighbour* logical rows must be
+    distinguishable from genuinely-touched lanes — a zero gradient value
+    cannot encode that (a touched row may legitimately have zero gradient).
+    Returns ``None`` for ``width >= 128`` (one logical row per physical row;
+    every summed row was genuinely touched)."""
+    if pack_factor(width) == 1:
+        return None
+    ones = jnp.ones((logical_ids.shape[0], width), dtype)
+    # identical lane placement to the update rows, by construction
+    return expand_update_rows(ones, logical_ids, width)[1]

@@ -16,7 +16,7 @@ from .grads import (
     resolve_dp_gradient,
     split_mp_dp,
 )
-from .optimizers import SparseAdagrad, SparseSGD
+from .optimizers import SparseAdagrad, SparseAdam, SparseMomentum, SparseSGD
 from .trainer import (
     HybridTrainState,
     init_hybrid_state,

@@ -305,8 +305,7 @@ class DistributedEmbedding:
         def fast_uniform(w, sharding=None):
             shape = (self.world_size, self.phys_cap[w], self.phys_w[w])
             fn = jax.jit(
-                lambda k: jax.random.uniform(k, shape, dtype,
-                                             minval=-0.05, maxval=0.05),
+                lambda k: default_embeddings_init(k, shape, dtype),
                 **({"out_shardings": sharding} if sharding is not None else {}))
             return fn(jax.random.fold_in(key, w))
 
@@ -368,16 +367,18 @@ class DistributedEmbedding:
 
     def local_view(self, params: EmbedParams) -> EmbedParams:
         """Squeeze the leading world axis of per-device slabs
-        (``[1, rows, w]`` inside shard_map / world_size==1 → ``[rows, w]``)."""
-        return {k: (v.reshape(v.shape[-2], v.shape[-1])
-                    if hasattr(v, "ndim") and v.ndim == 3 else v)
-                for k, v in params.items()}
+        (``[1, rows, w]`` inside shard_map / world_size==1 → ``[rows, w]``).
+        Tree-mapped so nested optimizer state (e.g. Adam's ``(m, v, t)``)
+        squeezes leaf-wise."""
+        return jax.tree.map(
+            lambda v: (v.reshape(v.shape[-2], v.shape[-1])
+                       if hasattr(v, "ndim") and v.ndim == 3 else v), params)
 
     def stacked_view(self, params: EmbedParams) -> EmbedParams:
         """Re-add the leading world axis for P(axis) out_specs."""
-        return {k: (v.reshape(1, *v.shape)
-                    if hasattr(v, "ndim") and v.ndim == 2 else v)
-                for k, v in params.items()}
+        return jax.tree.map(
+            lambda v: (v.reshape(1, *v.shape)
+                       if hasattr(v, "ndim") and v.ndim == 2 else v), params)
 
     def _table_rows(self, rank: int, m: int):
         cfg = self.strategy.local_configs_list[rank][m]
@@ -968,20 +969,40 @@ class DistributedEmbedding:
                 ids, vals = self._combiner_backward(
                     grad, inp, cfg.get("combiner"))
             shifted = jnp.where((ids >= 0) & (ids < rows), ids + roff, cap)
+            per_width.setdefault(k, []).append((shifted, vals, w))
+        return self._apply_width_streams(params, opt_state, per_width,
+                                         optimizer, lr, scale)
+
+    def _apply_width_streams(self, params: EmbedParams, opt_state,
+                             per_width: Dict[str, List], optimizer, lr,
+                             scale):
+        """Concatenate each width's (logical ids, update rows) stream,
+        lane-expand to physical full-tile rows, and run ONE optimizer scatter
+        per width slab. Stateful-moment optimizers additionally receive the
+        lane touch-mask (``ops/packed_slab.py:expand_touch_mask``) so packed
+        neighbour rows keep their state."""
+        new_params = dict(params)
+        new_state = dict(opt_state) if isinstance(opt_state, dict) else opt_state
+        wants_mask = getattr(optimizer, "needs_touch_mask", False)
+        for k in sorted(per_width):
+            tris = per_width[k]
+            w = tris[0][2]
+            ids = jnp.concatenate([t[0].reshape(-1) for t in tris])
+            vals = jnp.concatenate(
+                [t[1].reshape(-1, w) for t in tris]) * scale
             # lane-expand to physical rows: the scatter (and any dedup in the
             # optimizer) runs on full-tile rows; lane-disjoint placement keeps
             # per-logical-row semantics exact (ops/packed_slab.py)
-            phys_ids, pvals = ps.expand_update_rows(vals, shifted, w)
-            per_width.setdefault(k, []).append((phys_ids, pvals))
-        new_params = dict(params)
-        new_state = dict(opt_state) if isinstance(opt_state, dict) else opt_state
-        for k in sorted(per_width):
-            pairs = per_width[k]
-            ids = jnp.concatenate([p[0] for p in pairs])
-            vals = jnp.concatenate([p[1] for p in pairs]) * scale
+            phys_ids, pvals = ps.expand_update_rows(vals, ids, w)
+            kw = {}
+            if wants_mask:
+                m = ps.expand_touch_mask(ids, w, dtype=pvals.dtype)
+                if m is not None:
+                    kw["mask"] = m
             slab = new_params[k]
             st = new_state[k] if isinstance(new_state, dict) else new_state
-            slab, st = optimizer.apply_rows(slab, st, ids, vals, lr)
+            slab, st = optimizer.apply_rows(slab, st, phys_ids, pvals, lr,
+                                            **kw)
             new_params[k] = slab
             if isinstance(new_state, dict):
                 new_state[k] = st
@@ -1121,26 +1142,10 @@ class DistributedEmbedding:
                       & (valid[None, :, None] > 0))
                 ids = jnp.where(ok, values + roff[None, :, None], sent)
             per_width.setdefault(_wkey(g.width), []).append(
-                (ids.reshape(-1), vals.reshape(-1, g.width), g.width))
+                (ids, vals, g.width))
 
-        new_params = dict(params)
-        new_state = dict(opt_state) if isinstance(opt_state, dict) else opt_state
-        for k in sorted(per_width):
-            tris = per_width[k]
-            w = tris[0][2]
-            ids = jnp.concatenate([t[0] for t in tris])
-            vals = jnp.concatenate([t[1] for t in tris]) * scale
-            # lane-expand to physical rows: the scatter (and any dedup in the
-            # optimizer) runs on full-tile rows; lane-disjoint placement keeps
-            # per-logical-row semantics exact (ops/packed_slab.py)
-            phys_ids, pvals = ps.expand_update_rows(vals, ids, w)
-            slab = new_params[k]
-            st = new_state[k] if isinstance(new_state, dict) else new_state
-            slab, st = optimizer.apply_rows(slab, st, phys_ids, pvals, lr)
-            new_params[k] = slab
-            if isinstance(new_state, dict):
-                new_state[k] = st
-        return new_params, new_state
+        return self._apply_width_streams(params, opt_state, per_width,
+                                         optimizer, lr, scale)
 
     # ------------------------------------------------------------- checkpoint
 

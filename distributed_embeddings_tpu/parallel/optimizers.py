@@ -21,12 +21,24 @@ Invalid/padded ids equal the slab row capacity, land out of bounds, and are
 dropped (``mode='drop'``) — the static-shape analogue of the reference's
 dynamic ``num_unique``.
 
-:class:`SparseAdagrad` dedups duplicate ids first (sort + segment-sum — the
-CUB sort/unique of the reference backward, ``.cu:499-515``) because its update
-is nonlinear in the gradient; :class:`SparseSGD` scatter-adds duplicates
-directly. Numerics match ``optax.sgd`` / ``optax.adagrad`` (initial
-accumulator 0.1, eps 1e-7) so the dense data-parallel side can use optax and
-both families see the same optimizer semantics.
+:class:`SparseAdagrad`, :class:`SparseMomentum` and :class:`SparseAdam` dedup
+duplicate ids first (sort + segment-sum — the CUB sort/unique of the
+reference backward, ``.cu:499-515``) because their updates read-modify-write
+per-row state; :class:`SparseSGD` scatter-adds duplicates directly. Numerics
+match ``optax.sgd`` / ``optax.adagrad`` (initial accumulator 0.1, eps 1e-7) /
+``optax.sgd(momentum=...)`` / ``optax.adam`` so the dense data-parallel side
+can use optax and both families see the same optimizer semantics.
+
+**Lazy moment semantics** (momentum/Adam): only the rows touched by a step
+update their momentum/moment state; untouched rows' state neither decays nor
+produces an update. This is what the reference gets from Keras optimizers'
+sparse ``IndexedSlices`` path (``dist_model_parallel.py:526-567`` +
+``optimizer.apply_gradients``) and what every production embedding trainer
+uses — decaying millions of untouched rows per step would turn an O(touched)
+update into an O(all rows) one. Consequence: trajectories equal dense optax
+exactly when every row is touched every step, and diverge (lazily) when not.
+Adam's bias correction uses the *global* step count, not a per-row count —
+the LazyAdam convention.
 """
 
 from __future__ import annotations
@@ -82,3 +94,108 @@ class SparseAdagrad:
         slab = slab.at[uids].add(-update, mode="drop",
                                  indices_are_sorted=True)
         return slab, accum
+
+
+def _dedup_with_mask(ids, vals, mask, pad_id):
+    """Dedup vals (and, when given, a lane touch-mask) by id in ONE sort +
+    segment-sum: the mask rides as extra columns. Returns
+    ``(uids, uvals, touched)`` with ``touched=None`` when no mask.
+
+    Why a mask: stateful-moment updates are nonzero wherever *state* is
+    nonzero, so after duplicate physical rows are summed, lanes belonging to
+    packed neighbour logical rows (``ops/packed_slab.py``) must be masked
+    out of the state transition — a zero gradient cannot encode "untouched"
+    (a touched row may legitimately have zero gradient)."""
+    if mask is None:
+        uids, uvals = dedup_sparse_grad(ids, vals, pad_id=pad_id)
+        return uids, uvals, None
+    both = jnp.concatenate([vals, mask.astype(vals.dtype)], axis=1)
+    uids, uboth = dedup_sparse_grad(ids, both, pad_id=pad_id)
+    w = vals.shape[1]
+    return uids, uboth[:, :w], uboth[:, w:] > 0
+
+
+class SparseMomentum:
+    """Heavy-ball SGD with lazy row-wise momentum; ``optax.sgd(momentum=m)``
+    (``optax.trace``) numerics: ``trace = g + decay * trace``,
+    ``param -= lr * trace`` (``nesterov`` applies the optax formula
+    ``g + decay * trace_new``). See the module docstring for the lazy
+    semantics of untouched rows."""
+
+    needs_touch_mask = True
+
+    def __init__(self, momentum: float = 0.9, nesterov: bool = False):
+        self.momentum = momentum
+        self.nesterov = nesterov
+
+    def init(self, params):
+        return jax.tree.map(jnp.zeros_like, params)
+
+    def apply_rows(self, slab: jax.Array, trace: jax.Array, ids: jax.Array,
+                   vals: jax.Array, lr, mask=None):
+        vals = vals.astype(slab.dtype)
+        # read-modify-write of per-row trace: duplicates must sum first
+        uids, uvals, touched = _dedup_with_mask(
+            ids, vals, mask, pad_id=slab.shape[0])
+        t_rows = jnp.take(trace, uids, axis=0, mode="clip")
+        t_new = uvals + self.momentum * t_rows
+        if touched is not None:  # packed neighbours keep their state
+            t_new = jnp.where(touched, t_new, t_rows)
+        trace = trace.at[uids].set(t_new, mode="drop",
+                                   indices_are_sorted=True)
+        step = (uvals + self.momentum * t_new) if self.nesterov else t_new
+        if touched is not None:
+            step = jnp.where(touched, step, 0.0)
+        slab = slab.at[uids].add(-lr * step, mode="drop",
+                                 indices_are_sorted=True)
+        return slab, trace
+
+
+class SparseAdam:
+    """Adam with lazy row-wise moments; ``optax.adam`` numerics
+    (``scale_by_adam``: ``mu = b1*mu + (1-b1)*g``, ``nu = b2*nu +
+    (1-b2)*g^2``, hat-corrected by the optimizer-global step count — the
+    LazyAdam convention, see module docstring).
+
+    State per width slab: ``(mu, nu, count)`` where ``count`` rides as a
+    ``[..., 1, 1]`` array so it shards/squeezes uniformly with the slabs."""
+
+    needs_touch_mask = True
+
+    def __init__(self, b1: float = 0.9, b2: float = 0.999,
+                 eps: float = 1e-8, eps_root: float = 0.0):
+        self.b1, self.b2 = b1, b2
+        self.eps, self.eps_root = eps, eps_root
+
+    def init(self, params):
+        def one(p):
+            cnt_shape = (p.shape[0], 1, 1) if p.ndim == 3 else (1, 1)
+            return (jnp.zeros_like(p), jnp.zeros_like(p),
+                    jnp.zeros(cnt_shape, jnp.float32))
+        return jax.tree.map(one, params)
+
+    def apply_rows(self, slab: jax.Array, state, ids: jax.Array,
+                   vals: jax.Array, lr, mask=None):
+        mu, nu, count = state
+        vals = vals.astype(slab.dtype)
+        uids, uvals, touched = _dedup_with_mask(
+            ids, vals, mask, pad_id=slab.shape[0])
+        count = count + 1.0
+        t = count.reshape(())  # scalar step for bias correction
+        mu_rows = jnp.take(mu, uids, axis=0, mode="clip")
+        nu_rows = jnp.take(nu, uids, axis=0, mode="clip")
+        mu_new = self.b1 * mu_rows + (1.0 - self.b1) * uvals
+        nu_new = self.b2 * nu_rows + (1.0 - self.b2) * uvals * uvals
+        if touched is not None:  # packed neighbours keep their state
+            mu_new = jnp.where(touched, mu_new, mu_rows)
+            nu_new = jnp.where(touched, nu_new, nu_rows)
+        mu = mu.at[uids].set(mu_new, mode="drop", indices_are_sorted=True)
+        nu = nu.at[uids].set(nu_new, mode="drop", indices_are_sorted=True)
+        mu_hat = mu_new / (1.0 - self.b1 ** t)
+        nu_hat = nu_new / (1.0 - self.b2 ** t)
+        update = lr * mu_hat / (jnp.sqrt(nu_hat + self.eps_root) + self.eps)
+        if touched is not None:
+            update = jnp.where(touched, update, 0.0)
+        slab = slab.at[uids].add(-update, mode="drop",
+                                 indices_are_sorted=True)
+        return slab, (mu, nu, count)

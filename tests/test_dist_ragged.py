@@ -191,6 +191,7 @@ def test_ragged_sgd_step_matches_oracle(mesh):
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("column_slice_threshold", [None, 150])
 def test_ragged_sparse_trainer_step_matches_oracle(mesh,
                                                    column_slice_threshold):

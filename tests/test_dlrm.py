@@ -64,7 +64,8 @@ def test_dlrm_forward_and_local_train():
     assert np.isfinite(float(loss))
 
 
-@pytest.mark.parametrize("world", [1, 8])
+@pytest.mark.parametrize(
+    "world", [1, pytest.param(8, marks=pytest.mark.slow)])
 def test_dlrm_hybrid_training_loss_decreases(world):
     cfg = small_config(tables=10)  # >= world ranks (reference constraint)
     mesh = (Mesh(np.array(jax.devices()[:world]), ("data",))
@@ -108,6 +109,7 @@ def test_dlrm_hybrid_training_loss_decreases(world):
     assert np.isfinite(losses).all()
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("dp_input", [True, False])
 def test_dlrm_mesh_eval_matches_single_device(dp_input):
     """Distributed eval (shard_map forward + reassembled global predictions)
@@ -155,6 +157,7 @@ def test_dlrm_mesh_eval_matches_single_device(dp_input):
     assert 0.0 <= auc <= 1.0
 
 
+@pytest.mark.slow
 def test_dlrm_bf16_hybrid_training_loss_decreases():
     """Full bf16-compute hybrid step (bf16 MLPs + bf16 embedding exchange,
     fp32 master weights) trains stably — the reference's AMP configuration

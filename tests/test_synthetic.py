@@ -48,6 +48,7 @@ def test_average_pool_1d():
     np.testing.assert_allclose(out, [[1.5, 4.5], [7.5, 10.5]])
 
 
+@pytest.mark.slow
 def test_tiny_trains_on_mesh():
     world = 8
     mesh = Mesh(np.array(jax.devices()[:world]), ("data",))
