@@ -104,7 +104,8 @@ def build_synthetic(model_config: ModelConfig, world_size: int,
     de = DistributedEmbedding(table_configs, world_size=world_size,
                               strategy=strategy,
                               column_slice_threshold=column_slice_threshold,
-                              input_table_map=input_table_map)
+                              input_table_map=input_table_map,
+                              input_hotness=hotness)
     dense = SyntheticDense(mlp_sizes=tuple(model_config.mlp_sizes),
                            interact_stride=model_config.interact_stride)
     return de, dense, hotness

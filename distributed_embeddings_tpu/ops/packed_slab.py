@@ -167,21 +167,39 @@ def expand_update_rows(vals: jax.Array, logical_ids: jax.Array,
     return logical_ids // p, expanded
 
 
-def expand_touch_mask(logical_ids: jax.Array, width: int,
-                      dtype=jnp.float32) -> Optional[jax.Array]:
-    """Lane-placed 0/1 mask marking which lanes of each expanded update row
-    belong to the addressed *logical* row: ``[n, phys_width]``, 1.0 on the
-    addressed row's ``width`` lanes, 0 elsewhere.
+def lane_one_hot(logical_ids: jax.Array, width: int,
+                 dtype=jnp.float32) -> Optional[jax.Array]:
+    """Compact ``[n, p]`` one-hot of each update row's lane slot
+    (``p = 128 // width``), marking which packed *logical* row an expanded
+    update row addresses.
 
     Needed by stateful-moment optimizers (momentum/Adam): their update is
     nonzero wherever *state* is nonzero, so after duplicate physical rows are
     summed, lanes belonging to packed *neighbour* logical rows must be
     distinguishable from genuinely-touched lanes — a zero gradient value
     cannot encode that (a touched row may legitimately have zero gradient).
-    Returns ``None`` for ``width >= 128`` (one logical row per physical row;
-    every summed row was genuinely touched)."""
-    if pack_factor(width) == 1:
+    Kept ``p`` columns wide (not ``phys_width``) so riding it through the
+    dedup sort costs ``p/128`` of the value payload, and expanded to lanes
+    only after deduplication (:func:`expand_lane_mask`). Returns ``None``
+    for ``width >= 128`` (one logical row per physical row; every summed
+    row was genuinely touched)."""
+    p = pack_factor(width)
+    if p == 1:
         return None
-    ones = jnp.ones((logical_ids.shape[0], width), dtype)
-    # identical lane placement to the update rows, by construction
-    return expand_update_rows(ones, logical_ids, width)[1]
+    return jax.nn.one_hot((logical_ids % p).astype(jnp.int32), p, dtype=dtype)
+
+
+def expand_lane_mask(narrow: jax.Array, width: int,
+                     phys_w: Optional[int] = None) -> jax.Array:
+    """Expand a deduped ``[n, p]`` lane mask to lane-placed ``[n,
+    phys_width]`` booleans: column ``j`` of the narrow mask covers lanes
+    ``[j*width, (j+1)*width)`` — the same placement
+    :func:`expand_update_rows` gives the update values."""
+    p = narrow.shape[1]
+    out = jnp.repeat(narrow > 0, width, axis=1)
+    target = phys_w if phys_w is not None else LANES
+    pad = target - p * width
+    if pad:
+        out = jnp.concatenate(
+            [out, jnp.zeros((narrow.shape[0], pad), bool)], axis=1)
+    return out

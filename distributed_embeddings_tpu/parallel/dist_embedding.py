@@ -136,7 +136,10 @@ class DistributedEmbedding:
         ``embeddings_initializer``).
       world_size: mesh-axis size (model-parallel positions == data-parallel
         positions, as in the reference).
-      strategy: ``basic | memory_balanced | memory_optimized``.
+      strategy: ``basic | memory_balanced | memory_optimized |
+        comm_balanced`` (the last balances per-(width, inputs) table counts
+        so the padded output exchange wastes the fewest bytes — see
+        ``parallel/strategy.py``).
       column_slice_threshold: max elements per slice; larger tables are split
         width-wise into power-of-2 slices.
       row_slice: reserved (the reference declares-but-does-not-implement row
@@ -147,6 +150,8 @@ class DistributedEmbedding:
         (each rank holds the full global batch of ids for its local tables;
         no id all-to-all runs).
       input_table_map: ``input[i]`` uses ``table[input_table_map[i]]``.
+      input_hotness: optional per-input hotness hint; lets ``comm_balanced``
+        model the exchange groups exactly (see ``strategy.py``).
       axis_name: mesh axis the executor runs under (inside ``shard_map``).
       compute_dtype: output/communication dtype. Embedding reads and combiner
         reductions stay in the parameter dtype; outputs are cast to
@@ -166,7 +171,8 @@ class DistributedEmbedding:
                  dp_input: bool = True,
                  input_table_map: Optional[Sequence[int]] = None,
                  axis_name: str = "data",
-                 compute_dtype: Optional[Any] = None):
+                 compute_dtype: Optional[Any] = None,
+                 input_hotness: Optional[Sequence[int]] = None):
         if row_slice is not None:
             raise NotImplementedError("Row slicing embedding is not supported yet!")
         self.world_size = int(world_size)
@@ -176,7 +182,8 @@ class DistributedEmbedding:
         self.strategy = DistEmbeddingStrategy(
             embeddings, self.world_size, strategy=strategy,
             input_table_map=input_table_map,
-            column_slice_threshold=column_slice_threshold)
+            column_slice_threshold=column_slice_threshold,
+            input_hotness=input_hotness)
         if len(self.strategy.global_configs) < self.world_size:
             raise NotImplementedError(
                 "Fewer tables than mesh positions is not supported "
@@ -996,9 +1003,12 @@ class DistributedEmbedding:
             phys_ids, pvals = ps.expand_update_rows(vals, ids, w)
             kw = {}
             if wants_mask:
-                m = ps.expand_touch_mask(ids, w, dtype=pvals.dtype)
+                # compact [n, p] lane mask rides the optimizer's dedup and
+                # expands to lanes after (ops/packed_slab.py:lane_one_hot)
+                m = ps.lane_one_hot(ids, w, dtype=pvals.dtype)
                 if m is not None:
                     kw["mask"] = m
+                    kw["lane_width"] = w
             slab = new_params[k]
             st = new_state[k] if isinstance(new_state, dict) else new_state
             slab, st = optimizer.apply_rows(slab, st, phys_ids, pvals, lr,

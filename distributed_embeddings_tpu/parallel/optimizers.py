@@ -47,6 +47,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..ops.packed_slab import expand_lane_mask
 from ..ops.sparse_grad import dedup_sparse_grad
 
 
@@ -96,9 +97,11 @@ class SparseAdagrad:
         return slab, accum
 
 
-def _dedup_with_mask(ids, vals, mask, pad_id):
-    """Dedup vals (and, when given, a lane touch-mask) by id in ONE sort +
-    segment-sum: the mask rides as extra columns. Returns
+def _dedup_with_mask(ids, vals, mask, lane_width, pad_id):
+    """Dedup vals (and, when given, a compact ``[n, p]`` lane touch-mask,
+    ``ops/packed_slab.py:lane_one_hot``) by id in ONE sort + segment-sum:
+    the mask rides as ``p`` extra columns (p/128 of the value payload) and
+    is expanded to lane placement only after dedup. Returns
     ``(uids, uvals, touched)`` with ``touched=None`` when no mask.
 
     Why a mask: stateful-moment updates are nonzero wherever *state* is
@@ -112,7 +115,8 @@ def _dedup_with_mask(ids, vals, mask, pad_id):
     both = jnp.concatenate([vals, mask.astype(vals.dtype)], axis=1)
     uids, uboth = dedup_sparse_grad(ids, both, pad_id=pad_id)
     w = vals.shape[1]
-    return uids, uboth[:, :w], uboth[:, w:] > 0
+    touched = expand_lane_mask(uboth[:, w:], lane_width, phys_w=w)
+    return uids, uboth[:, :w], touched
 
 
 class SparseMomentum:
@@ -132,11 +136,11 @@ class SparseMomentum:
         return jax.tree.map(jnp.zeros_like, params)
 
     def apply_rows(self, slab: jax.Array, trace: jax.Array, ids: jax.Array,
-                   vals: jax.Array, lr, mask=None):
+                   vals: jax.Array, lr, mask=None, lane_width=None):
         vals = vals.astype(slab.dtype)
         # read-modify-write of per-row trace: duplicates must sum first
         uids, uvals, touched = _dedup_with_mask(
-            ids, vals, mask, pad_id=slab.shape[0])
+            ids, vals, mask, lane_width, pad_id=slab.shape[0])
         t_rows = jnp.take(trace, uids, axis=0, mode="clip")
         t_new = uvals + self.momentum * t_rows
         if touched is not None:  # packed neighbours keep their state
@@ -175,11 +179,11 @@ class SparseAdam:
         return jax.tree.map(one, params)
 
     def apply_rows(self, slab: jax.Array, state, ids: jax.Array,
-                   vals: jax.Array, lr, mask=None):
+                   vals: jax.Array, lr, mask=None, lane_width=None):
         mu, nu, count = state
         vals = vals.astype(slab.dtype)
         uids, uvals, touched = _dedup_with_mask(
-            ids, vals, mask, pad_id=slab.shape[0])
+            ids, vals, mask, lane_width, pad_id=slab.shape[0])
         count = count + 1.0
         t = count.reshape(())  # scalar step for bias correction
         mu_rows = jnp.take(mu, uids, axis=0, mode="clip")

@@ -19,12 +19,14 @@ Planned artifacts (names kept aligned with the reference for parity auditing):
 
 from __future__ import annotations
 
+from collections import Counter, defaultdict
 from typing import Any, Dict, List, Optional, Sequence
 
 
 Config = Dict[str, Any]
 
-_STRATEGIES = ("basic", "memory_balanced", "memory_optimized")
+_STRATEGIES = ("basic", "memory_balanced", "memory_optimized",
+               "comm_balanced")
 
 
 def _table_elements(config: Config) -> int:
@@ -57,7 +59,10 @@ def maybe_slice_table_column(orig_config: Config,
 
 
 def apply_strategy(mode: str, world_size: int,
-                   sliced_configs: List[List[Config]]) -> List[List[int]]:
+                   sliced_configs: List[List[Config]],
+                   input_table_map: Optional[Sequence[int]] = None,
+                   input_hotness: Optional[Sequence[int]] = None
+                   ) -> List[List[int]]:
     """Assign sliced tables to ranks; returns per-rank lists of global table ids
     (reference ``dist_model_parallel.py:160-196``).
 
@@ -66,13 +71,26 @@ def apply_strategy(mode: str, world_size: int,
       even while balancing bytes.
     * ``memory_optimized``: greedy largest-first onto the least-loaded rank —
       best byte balance, table counts may skew.
+    * ``comm_balanced``: balances the *exchange*, not just bytes. The
+      executor's output all-to-all pads each (width, hotness) slot group to
+      the max per-rank slot count (``parallel/plan.py``), so skewed per-group
+      counts turn into padded exchange bytes (measured 40%+ waste under
+      ``memory_optimized`` on the tiny/small zoo, ``docs/perf_tpu.md``).
+      Each table's group footprint — one slot in group ``(width, h)`` per
+      input of hotness ``h`` it serves (hotness from ``input_hotness`` when
+      given, else assumed 1) — is placed greedily, largest footprint first,
+      on the rank that minimally grows the total padded exchange width
+      ``sum_g w_g * max_r n_{g,r}``, tie-broken by byte load. Directly
+      minimizes the executor's padding objective while keeping bytes close.
     """
     flat_ids: List[int] = []
     flat_sizes: List[int] = []
+    flat_widths: List[int] = []
     for tid, slices in enumerate(sliced_configs):
         for cfg in slices:
             flat_ids.append(tid)
             flat_sizes.append(_table_elements(cfg))
+            flat_widths.append(int(cfg["output_dim"]))
 
     if mode == "basic":
         return [flat_ids[r::world_size] for r in range(world_size)]
@@ -94,6 +112,43 @@ def apply_strategy(mode: str, world_size: int,
             bins.sort()
         return [b[1] for b in bins]
 
+    if mode == "comm_balanced":
+        itm = (list(input_table_map) if input_table_map is not None
+               else list(range(len(sliced_configs))))
+        hot = (list(input_hotness) if input_hotness is not None
+               else [1] * len(itm))
+        # hotness multiset per source table; every slice of it inherits
+        table_hots: Dict[int, Counter] = defaultdict(Counter)
+        for i, tid in enumerate(itm):
+            table_hots[tid][int(hot[i])] += 1
+        # slice footprint: slots contributed per (width, hotness) group
+        items = []
+        for pos, (tid, size, w) in enumerate(
+                zip(flat_ids, flat_sizes, flat_widths)):
+            groups = {(w, h): c for h, c in table_hots[tid].items()}
+            fp = w * sum(table_hots[tid].values())  # output columns it adds
+            items.append((fp, size, pos, tid, groups))
+        items.sort(key=lambda t: (-t[0], -t[1], t[2]))  # LPT on columns
+        n: Dict[tuple, List[int]] = defaultdict(lambda: [0] * world_size)
+        loads = [0] * world_size
+        out: List[List[tuple]] = [[] for _ in range(world_size)]
+        for fp, size, pos, tid, groups in items:
+            best, best_key = None, None
+            for r in range(world_size):
+                # marginal growth of the padded exchange width
+                delta = 0
+                for (w, h), c in groups.items():
+                    cur_max = max(n[(w, h)])
+                    delta += w * max(0, n[(w, h)][r] + c - cur_max)
+                key = (delta, loads[r], r)
+                if best_key is None or key < best_key:
+                    best, best_key = r, key
+            out[best].append((pos, tid))
+            loads[best] += size
+            for (w, h), c in groups.items():
+                n[(w, h)][best] += c
+        return [[tid for _, tid in sorted(rank)] for rank in out]
+
     raise ValueError(f"Unsupported strategy {mode}")
 
 
@@ -109,6 +164,9 @@ class DistEmbeddingStrategy:
       input_table_map: ``input[i]`` looks up ``table[input_table_map[i]]``;
         ``None`` means the identity (shared tables = repeated ids).
       column_slice_threshold: max elements per table slice (power-of-2 split).
+      input_hotness: optional per-input hotness hint used only by the
+        ``comm_balanced`` strategy to model the executor's (width, hotness)
+        exchange groups exactly; placement stays valid without it.
     """
 
     def __init__(self,
@@ -116,7 +174,8 @@ class DistEmbeddingStrategy:
                  world_size: int,
                  strategy: str = "basic",
                  input_table_map: Optional[Sequence[int]] = None,
-                 column_slice_threshold: Optional[int] = None):
+                 column_slice_threshold: Optional[int] = None,
+                 input_hotness: Optional[Sequence[int]] = None):
         if strategy not in _STRATEGIES:
             raise ValueError(f"Unsupported shard strategy {strategy}")
         self.strategy = strategy
@@ -130,6 +189,12 @@ class DistEmbeddingStrategy:
         if len(input_table_map) and max(input_table_map) >= len(self.global_configs):
             raise ValueError("input_table_map refers to a nonexistent table")
         self.input_table_map = list(input_table_map)
+        if (input_hotness is not None
+                and len(input_hotness) != len(self.input_table_map)):
+            raise ValueError(
+                f"input_hotness has {len(input_hotness)} entries but there "
+                f"are {len(self.input_table_map)} inputs (it is per-input, "
+                "not per-table)")
 
         if world_size == 1:
             self.local_configs = self.global_configs
@@ -147,7 +212,10 @@ class DistEmbeddingStrategy:
 
         sliced_configs, self.sliced_out_ranges = self.create_sliced_configs(
             world_size, column_slice_threshold, self.input_table_map)
-        self.table_ids_list = apply_strategy(strategy, world_size, sliced_configs)
+        self.table_ids_list = apply_strategy(strategy, world_size,
+                                             sliced_configs,
+                                             self.input_table_map,
+                                             input_hotness)
 
         # Build the global routing view, consuming each table's slices in rank
         # order (reference dist_model_parallel.py:70-98).

@@ -97,10 +97,11 @@ def dist_forward_fn(de, mesh, n_inputs):
         out_specs=P("data")))
 
 
-SEEDS = {"basic": 101, "memory_balanced": 202, "memory_optimized": 303}
+SEEDS = {"basic": 101, "memory_balanced": 202, "memory_optimized": 303,
+         "comm_balanced": 404}
 
 
-@pytest.mark.parametrize("strategy", ["basic", "memory_balanced",
+@pytest.mark.parametrize("strategy", ["basic", "memory_balanced", "comm_balanced",
                                       "memory_optimized"])
 @pytest.mark.parametrize("column_slice_threshold", [None, 150])
 def test_forward_matches_reference(mesh, strategy, column_slice_threshold):
@@ -251,7 +252,7 @@ def dist_forward_mp_fn(de, mesh):
         out_specs=P("data")))
 
 
-@pytest.mark.parametrize("strategy", ["basic", "memory_balanced",
+@pytest.mark.parametrize("strategy", ["basic", "memory_balanced", "comm_balanced",
                                       "memory_optimized"])
 @pytest.mark.parametrize("column_slice_threshold", [None, 150])
 def test_mp_input_forward_matches_reference(mesh, strategy,

@@ -123,3 +123,54 @@ def test_column_slice_widths_sum():
               (s.local_configs_list[0] + s.local_configs_list[1] +
                s.local_configs_list[2] + s.local_configs_list[3])]
     assert sum(widths) == 9 and len(widths) == 4
+
+
+def test_comm_balanced_class_counts():
+    """Per-(width, inputs) class counts differ by at most 1 across ranks,
+    and bytes stay balanced."""
+    import numpy as np
+    rng = np.random.default_rng(0)
+    configs = []
+    # three width classes with skewed sizes (memory_optimized would bunch
+    # the small ones onto few ranks)
+    for _ in range(9):
+        configs.append(cfg(int(rng.integers(10, 20)), 8))
+    for _ in range(10):
+        configs.append(cfg(int(rng.integers(1000, 2000)), 16))
+    for _ in range(5):
+        configs.append(cfg(int(rng.integers(100000, 200000)), 32))
+    sliced = [[c] for c in configs]
+    world = 4
+    ids = apply_strategy("comm_balanced", world, sliced,
+                         input_table_map=list(range(len(configs))))
+    assert sorted(t for r in ids for t in r) == list(range(len(configs)))
+    widths = [c["output_dim"] for c in configs]
+    for w in (8, 16, 32):
+        counts = [sum(1 for t in r if widths[t] == w) for r in ids]
+        assert max(counts) - min(counts) <= 1, (w, counts)
+    loads = [sum(configs[t]["input_dim"] * configs[t]["output_dim"]
+                 for t in r) for r in ids]
+    assert max(loads) < 2.2 * min(loads)
+
+
+def test_comm_balanced_shared_tables_classed_apart():
+    """Tables with different input multiplicity form separate classes (the
+    hotness proxy), each balanced on its own."""
+    configs = [cfg(100, 8) for _ in range(8)]
+    # tables 0..3 each serve two inputs; 4..7 one input
+    itm = [0, 0, 1, 1, 2, 2, 3, 3, 4, 5, 6, 7]
+    sliced = [[c] for c in configs]
+    ids = apply_strategy("comm_balanced", 4, sliced, input_table_map=itm)
+    for r in ids:
+        shared = sum(1 for t in r if t < 4)
+        single = sum(1 for t in r if t >= 4)
+        assert shared == 1 and single == 1, ids
+
+
+def test_comm_balanced_end_to_end_parity():
+    """comm_balanced produces a valid plan: routing maps stay consistent."""
+    configs = [cfg(50 + i, [4, 8, 16][i % 3]) for i in range(10)]
+    s = DistEmbeddingStrategy(configs, 4, strategy="comm_balanced")
+    routed = sorted(i for r in s.input_ids_list for i in r)
+    assert routed == list(range(10))
+    assert sorted(s.rev_global_input_ids) == list(range(10))
