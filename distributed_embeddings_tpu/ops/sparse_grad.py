@@ -52,7 +52,8 @@ def combiner_grad_values(out_grad: jax.Array, row_splits: jax.Array,
 
 def dedup_sparse_grad(ids: jax.Array, grads: jax.Array, *,
                       pad_id: int,
-                      valid: Optional[jax.Array] = None
+                      valid: Optional[jax.Array] = None,
+                      max_unique: Optional[int] = None
                       ) -> Tuple[jax.Array, jax.Array]:
     """Sort ids and sum gradient rows of duplicates.
 
@@ -64,14 +65,24 @@ def dedup_sparse_grad(ids: jax.Array, grads: jax.Array, *,
         that ``.at[ids].op(..., mode='drop')`` ignores those rows.
       valid: optional ``[n]`` bool mask; invalid entries are replaced by
         ``pad_id`` before sorting.
+      max_unique: optional static bound on the number of distinct values in
+        ``ids`` (including the sentinel) — the **vocab bound**: distinct row
+        ids can never exceed the table's row capacity + 1. Output buffers
+        shrink to ``U = min(n, max_unique)``, shrinking every downstream
+        per-unique-row op with them — a multiplicative win whenever the
+        batch id stream is much longer than the vocab (small tables under
+        power-law traffic: tiny-zoo w=8 is a 2.7M-id stream over ~60k rows).
+        Passing a bound smaller than the true distinct count silently drops
+        the largest ids' gradients — callers must guarantee it.
 
     Returns:
-      ``(unique_ids, unique_grads)`` with the same ``[n]``/``[n, width]``
-      shapes: position ``k < num_unique`` holds the k-th smallest unique id and
-      the sum of its gradient rows; positions past that hold ``pad_id`` and
-      garbage (callers scatter with ``mode='drop'``).
+      ``(unique_ids [U], unique_grads [U, width])``: position
+      ``k < num_unique`` holds the k-th smallest unique id and the sum of
+      its gradient rows; positions past that hold ``pad_id`` and garbage
+      (callers scatter with ``mode='drop'``).
     """
     n = ids.shape[0]
+    u = n if max_unique is None else min(n, int(max_unique))
     if valid is not None:
         ids = jnp.where(valid, ids, pad_id)
     sorted_ids, perm = jax.lax.sort_key_val(ids, jnp.arange(n, dtype=jnp.int32))
@@ -80,8 +91,15 @@ def dedup_sparse_grad(ids: jax.Array, grads: jax.Array, *,
         [jnp.ones((1,), jnp.int32),
          (sorted_ids[1:] != sorted_ids[:-1]).astype(jnp.int32)])
     seg = jnp.cumsum(boundary) - 1  # [n], segment index per sorted row
-    unique_grads = jnp.zeros_like(sorted_grads).at[seg].add(sorted_grads, mode="drop")
-    unique_ids = jnp.full((n,), pad_id, dtype=ids.dtype).at[seg].set(sorted_ids, mode="drop")
-    # Padding ids sort last and get their own segment(s) holding pad_id: dropped
-    # downstream by the same out-of-range rule the scatters here rely on.
+    # seg ascends by construction; declaring it buys the sorted-scatter fast
+    # path (measured 1.8x on v5e, docs/perf_tpu.md)
+    unique_grads = jnp.zeros((u,) + grads.shape[1:], grads.dtype
+                             ).at[seg].add(sorted_grads, mode="drop",
+                                           indices_are_sorted=True)
+    unique_ids = jnp.full((u,), pad_id, dtype=ids.dtype
+                          ).at[seg].set(sorted_ids, mode="drop",
+                                        indices_are_sorted=True)
+    # Padding ids sort last and get their own segment(s) holding pad_id:
+    # either past u (dropped here) or dropped downstream by the same
+    # out-of-range rule the scatters rely on.
     return unique_ids, unique_grads

@@ -36,10 +36,12 @@ JAX SPMD instead of Horovod MPMD:
   Horovod's registered alltoall gradient.
 
 Input contract (distributed path): per feature either a dense int array
-(``[local_batch]`` or ``[local_batch, hotness]``) or a static-capacity
+(``[local_batch]`` or ``[local_batch, hotness]``), a static-capacity
 :class:`~..ops.embedding_lookup.Ragged` (values ``[cap]``, row_splits
-``[local_batch+1]``; combiner required), identical batch and capacities on
-every rank. **Ids must lie in ``[0, input_dim)``** — same contract as the
+``[local_batch+1]``; combiner required), or a
+:class:`~..ops.embedding_lookup.SparseIds` COO batch (converted to CSR on
+entry — beyond the reference, whose distributed path is dense-only while its
+local layers accept sparse). Identical batch and capacities on every rank. **Ids must lie in ``[0, input_dim)``** — same contract as the
 reference (TF's gather on out-of-range ids is undefined on GPU). Out-of-range
 ids here are clipped in the forward (a safety net so a bad id cannot read a
 neighbouring table in the slab) but routed to the dropped sentinel in the
@@ -60,7 +62,8 @@ from flax import struct
 from jax import lax
 
 from ..layers.embedding import default_embeddings_init
-from ..ops.embedding_lookup import Ragged, ragged_row_ids
+from ..ops.embedding_lookup import (Ragged, SparseIds, ragged_row_ids,
+                                    row_to_split)
 from ..ops import packed_slab as ps
 from . import plan as plan_mod
 from .strategy import DistEmbeddingStrategy
@@ -251,7 +254,12 @@ class DistributedEmbedding:
         p = ps.pack_factor(width)
         pw = self.phys_w[width]
         cfgs = self.strategy.local_configs_list[rank]
-        parts = []
+        # tables write into a preallocated slab (in-place update chain under
+        # jit) instead of list+concat: concat would hold all parts AND the
+        # result live at once — 2x the slab in HBM, an OOM at uncapped
+        # Criteo scale (8.7 GB of bf16 tables)
+        buf = jnp.zeros((self.phys_cap[width], pw), dtype)
+        pos = 0
         for m, cfg in enumerate(cfgs):
             if int(cfg["output_dim"]) != width:
                 continue
@@ -270,16 +278,13 @@ class DistributedEmbedding:
                         [t, jnp.zeros((rows_al - rows, width), dtype)])
                 if p > 1:  # pack: phys row i, lane j <- logical row i*p+j
                     t = jnp.concatenate([t[j::p] for j in range(p)], axis=1)
-            if p * width < pw:  # odd widths: pad dead lanes
-                t = jnp.concatenate(
-                    [t, jnp.zeros((t.shape[0], pw - p * width), dtype)],
-                    axis=1)
-            parts.append(t)
-        total = sum(part.shape[0] for part in parts)
-        pad = self.phys_cap[width] - total
-        if pad:
-            parts.append(jnp.zeros((pad, pw), dtype))
-        return jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+            # dynamic_update_slice would silently clamp an overrun into the
+            # previous table's rows; fail loudly on planner/capacity drift
+            assert pos + t.shape[0] <= self.phys_cap[width], (
+                width, pos, t.shape, self.phys_cap[width])
+            buf = lax.dynamic_update_slice(buf, t.astype(dtype), (pos, 0))
+            pos += t.shape[0]
+        return buf
 
     def init(self, key, dtype=jnp.float32, mesh=None) -> EmbedParams:
         """Build the global param dict ``{width: [world, rows_cap, width]}``.
@@ -407,6 +412,16 @@ class DistributedEmbedding:
         if len(inputs) != self.strategy.num_inputs:
             raise ValueError(
                 f"Expected {self.strategy.num_inputs} inputs, got {len(inputs)}")
+        # COO sparse rides the ragged path: row ids -> CSR row_splits, the
+        # same conversion the op layer's dispatcher does
+        # (ops/embedding_lookup.py:row_to_split; reference
+        # embedding_lookup_ops.py:90-96)
+        inputs = [
+            Ragged(values=inp.values,
+                   row_splits=row_to_split(inp.indices, inp.dense_shape[0],
+                                           dtype=inp.values.dtype))
+            if isinstance(inp, SparseIds) else inp
+            for inp in inputs]
         comm_dtype = jnp.int32
         for inp in inputs:
             arrs = ((inp.values, inp.row_splits) if isinstance(inp, Ragged)
@@ -437,6 +452,23 @@ class DistributedEmbedding:
         return out, encs, was_1d
 
     @staticmethod
+    def _csr_seg(lengths, cap: int):
+        """CSR offsets and per-position segment ids from per-row lengths,
+        for any leading batch dims: ``lengths [..., b]`` ->
+        ``(splits [..., b+1], seg [..., cap])`` with positions past each
+        CSR's total mapped to ``b``. The one derivation every ragged path
+        shares (the reference's ``RowToSplit``/``OffsetToWeightsAndRowId``
+        pair, ``embedding_lookup_kernels.cu:331-361``)."""
+        lead = lengths.shape[:-1]
+        b = lengths.shape[-1]
+        flat = lengths.reshape(-1, b)
+        zero = jnp.zeros((flat.shape[0], 1), flat.dtype)
+        splits = jnp.concatenate([zero, jnp.cumsum(flat, axis=1)], axis=1)
+        seg = jax.vmap(functools.partial(ragged_row_ids, capacity=cap))(
+            splits)
+        return splits.reshape(*lead, b + 1), seg.reshape(*lead, cap)
+
+    @staticmethod
     def _ragged_segments(cap: int, lengths):
         """Per-value segment ids for a ``[S, cap]`` block of per-source CSR
         values: ``(gseg [S*cap], valid [S*cap])`` with padding positions
@@ -444,11 +476,8 @@ class DistributedEmbedding:
         ``RowToSplit``/``OffsetToWeightsAndRowId`` pair of the reference
         (``embedding_lookup_kernels.cu:331-361``), vectorized."""
         S, b = lengths.shape
-        splits = jnp.concatenate(
-            [jnp.zeros((S, 1), lengths.dtype), jnp.cumsum(lengths, axis=1)],
-            axis=1)  # [S, b+1]
+        splits, seg = DistributedEmbedding._csr_seg(lengths, cap)
         pos = jnp.arange(cap, dtype=splits.dtype)
-        seg = jax.vmap(lambda sp: ragged_row_ids(sp, cap))(splits)
         valid = (pos[None, :] < splits[:, -1:]) & (seg < b)
         src = jnp.arange(S, dtype=seg.dtype)[:, None]
         gseg = jnp.where(valid, src * b + seg, S * b).reshape(-1)
@@ -461,11 +490,19 @@ class DistributedEmbedding:
         per source shard; output is ``[S*b, width]``."""
         S, cap = values.shape
         b = lengths.shape[1]
-        gseg, _ = self._ragged_segments(cap, lengths)
+        _, seg = self._csr_seg(lengths, cap)
+        # per-source sentinel row b keeps the flattened segment ids globally
+        # ascending ((b+1)-strided blocks, CSR-ascending within each) so the
+        # combine scatter can declare indices_are_sorted (1.8x fast path,
+        # docs/perf_tpu.md); sentinel rows slice off below
+        src = jnp.arange(S, dtype=seg.dtype)[:, None]
+        gseg = (src * (b + 1) + jnp.minimum(seg, b)).reshape(-1)
         ids = (jnp.clip(values, 0, rows - 1) + roff).reshape(-1)
         gathered = ps.packed_gather(slab, ids, width)
-        out = jnp.zeros((S * b + 1, gathered.shape[1]), gathered.dtype)
-        out = out.at[gseg].add(gathered, mode="drop")[:S * b]
+        out = jnp.zeros((S * (b + 1), gathered.shape[1]), gathered.dtype)
+        out = out.at[gseg].add(gathered, mode="drop",
+                               indices_are_sorted=True)
+        out = out.reshape(S, b + 1, -1)[:, :b, :].reshape(S * b, -1)
         if combiner == "mean":
             counts = jnp.maximum(lengths.reshape(-1), 1).astype(out.dtype)
             out = out / counts[:, None]
@@ -850,10 +887,7 @@ class DistributedEmbedding:
         r3 = region.reshape(world, g.n, g.blen)
         values = r3[:, :, :g.hot]
         lengths = r3[:, :, g.hot:] * valid[None, :, None].astype(r3.dtype)
-        zero = jnp.zeros((world, g.n, 1), lengths.dtype)
-        splits = jnp.concatenate([zero, jnp.cumsum(lengths, axis=2)], axis=2)
-        seg = jax.vmap(jax.vmap(
-            functools.partial(ragged_row_ids, capacity=g.hot)))(splits)
+        _, seg = self._csr_seg(lengths, g.hot)
         grow = (jnp.clip(values, 0, (rows - 1)[None, :, None])
                 + roff[None, :, None])
         counts = jnp.maximum(lengths, 1)
@@ -901,8 +935,10 @@ class DistributedEmbedding:
                 gath = ps.packed_gather(slab, grow, g.width)  # [w, n, cap, ww]
                 sidx = self._ragged_scatter_idx(g, b, world, seg)
                 buf = jnp.zeros((world * g.n * (b + 1), g.width), gath.dtype)
+                # sidx ascends globally: (source, slot) blocks are laid out
+                # ascending and seg ascends within each CSR block
                 buf = buf.at[sidx.reshape(-1)].add(
-                    gath.reshape(-1, g.width))
+                    gath.reshape(-1, g.width), indices_are_sorted=True)
                 red = buf.reshape(world, g.n, b + 1, g.width)[:, :, :b, :]
                 red = jnp.where(mean[None, :, None, None] > 0,
                                 red / counts[..., None].astype(red.dtype),

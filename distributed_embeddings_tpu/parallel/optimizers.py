@@ -80,8 +80,12 @@ class SparseAdagrad:
     def apply_rows(self, slab: jax.Array, accum: jax.Array, ids: jax.Array,
                    vals: jax.Array, lr):
         vals = vals.astype(slab.dtype)
-        # nonlinear in g: must sum duplicate rows before the rsqrt
-        uids, uvals = dedup_sparse_grad(ids, vals, pad_id=slab.shape[0])
+        # nonlinear in g: must sum duplicate rows before the rsqrt.
+        # vocab bound: distinct physical rows <= slab rows + sentinel, so
+        # the unique buffers (and the accumulator ops on them) shrink to
+        # min(stream, rows+1) — a large win for small-vocab width groups
+        uids, uvals = dedup_sparse_grad(ids, vals, pad_id=slab.shape[0],
+                                        max_unique=slab.shape[0] + 1)
         acc_rows = jnp.take(accum, uids, axis=0, mode="clip")
         new_acc = acc_rows + uvals * uvals
         # uids are sorted but NOT formally unique: the dedup tail repeats the
@@ -110,10 +114,16 @@ def _dedup_with_mask(ids, vals, mask, lane_width, pad_id):
     out of the state transition — a zero gradient cannot encode "untouched"
     (a touched row may legitimately have zero gradient)."""
     if mask is None:
-        uids, uvals = dedup_sparse_grad(ids, vals, pad_id=pad_id)
+        uids, uvals = dedup_sparse_grad(ids, vals, pad_id=pad_id,
+                                        max_unique=pad_id + 1)
         return uids, uvals, None
+    if lane_width is None:
+        raise ValueError(
+            "mask requires lane_width (the logical row width the [n, p] "
+            "lane mask expands to; 128//p is wrong for odd widths)")
     both = jnp.concatenate([vals, mask.astype(vals.dtype)], axis=1)
-    uids, uboth = dedup_sparse_grad(ids, both, pad_id=pad_id)
+    uids, uboth = dedup_sparse_grad(ids, both, pad_id=pad_id,
+                                    max_unique=pad_id + 1)
     w = vals.shape[1]
     touched = expand_lane_mask(uboth[:, w:], lane_width, phys_w=w)
     return uids, uboth[:, :w], touched

@@ -243,3 +243,57 @@ def test_ragged_sparse_trainer_step_matches_oracle(mesh,
     for a, b in zip(dist_tables, ref_tables):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_coo_through_distributed_wrapper(mesh):
+    """SparseIds (COO) inputs convert to CSR on entry and match the ragged
+    oracle — the wrapper accepts everything the op layer does (beyond the
+    reference, whose distributed path is dense-only)."""
+    from distributed_embeddings_tpu.ops.embedding_lookup import SparseIds
+
+    rng = np.random.default_rng(71)
+    configs, kinds = ragged_model(rng)
+    de = DistributedEmbedding(configs, world_size=WORLD,
+                              strategy="memory_balanced")
+    flat = de.init(jax.random.key(0), mesh=mesh)
+    tables = de.get_weights(flat)
+    dist_inputs, shard_rows = make_mixed_inputs(rng, configs, kinds)
+
+    # re-encode every ragged feature as per-shard COO stacked like the
+    # Ragged convention ([WORLD*cap] values / [WORLD*(b+1)] splits)
+    cap = LOCAL_B * MAX_HOT
+    coo_inputs = []
+    for i, (inp, kind) in enumerate(zip(dist_inputs, kinds)):
+        if kind == "dense":
+            coo_inputs.append(inp)
+            continue
+        idx_parts, val_parts = [], []
+        for s in range(WORLD):
+            rows = shard_rows[i][s]
+            ind = np.full((cap, 2), LOCAL_B, np.int32)  # pad rows >= batch
+            vals = np.zeros(cap, np.int32)
+            k = 0
+            for rr, ids in enumerate(rows):
+                for v in ids:
+                    ind[k] = (rr, k)
+                    vals[k] = v
+                    k += 1
+            idx_parts.append(ind)
+            val_parts.append(vals)
+        coo_inputs.append(SparseIds(
+            indices=jnp.asarray(np.concatenate(idx_parts)),
+            values=jnp.asarray(np.concatenate(val_parts)),
+            dense_shape=(LOCAL_B, MAX_HOT)))
+
+    def fwd(params, inps):
+        return tuple(de(params, list(inps)))
+
+    # SparseIds shards: indices [WORLD*cap, 2] / values [WORLD*cap] split
+    # along dim 0 by the mesh axis
+    outs = jax.jit(jax.shard_map(
+        fwd, mesh=mesh, in_specs=(P("data"), P("data")),
+        out_specs=P("data")))(flat, tuple(coo_inputs))
+    expect = oracle_forward(tables, configs, kinds, dist_inputs, shard_rows)
+    for o, e in zip(outs, expect):
+        np.testing.assert_allclose(np.asarray(o), np.asarray(e),
+                                   rtol=1e-5, atol=1e-6)
