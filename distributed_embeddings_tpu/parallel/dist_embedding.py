@@ -468,46 +468,6 @@ class DistributedEmbedding:
             splits)
         return splits.reshape(*lead, b + 1), seg.reshape(*lead, cap)
 
-    @staticmethod
-    def _ragged_segments(cap: int, lengths):
-        """Per-value segment ids for a ``[S, cap]`` block of per-source CSR
-        values: ``(gseg [S*cap], valid [S*cap])`` with padding positions
-        routed to the dropped sentinel segment ``S*b``. The
-        ``RowToSplit``/``OffsetToWeightsAndRowId`` pair of the reference
-        (``embedding_lookup_kernels.cu:331-361``), vectorized."""
-        S, b = lengths.shape
-        splits, seg = DistributedEmbedding._csr_seg(lengths, cap)
-        pos = jnp.arange(cap, dtype=splits.dtype)
-        valid = (pos[None, :] < splits[:, -1:]) & (seg < b)
-        src = jnp.arange(S, dtype=seg.dtype)[:, None]
-        gseg = jnp.where(valid, src * b + seg, S * b).reshape(-1)
-        return gseg, valid.reshape(-1)
-
-    def _ragged_block_combine(self, slab, roff, rows, width, values, lengths,
-                              combiner):
-        """Fused lookup+combine for a routed ragged feature: ``values
-        [S, cap]`` / ``lengths [S, b]`` hold one static-capacity CSR block
-        per source shard; output is ``[S*b, width]``."""
-        S, cap = values.shape
-        b = lengths.shape[1]
-        _, seg = self._csr_seg(lengths, cap)
-        # per-source sentinel row b keeps the flattened segment ids globally
-        # ascending ((b+1)-strided blocks, CSR-ascending within each) so the
-        # combine scatter can declare indices_are_sorted (1.8x fast path,
-        # docs/perf_tpu.md); sentinel rows slice off below
-        src = jnp.arange(S, dtype=seg.dtype)[:, None]
-        gseg = (src * (b + 1) + jnp.minimum(seg, b)).reshape(-1)
-        ids = (jnp.clip(values, 0, rows - 1) + roff).reshape(-1)
-        gathered = ps.packed_gather(slab, ids, width)
-        out = jnp.zeros((S * (b + 1), gathered.shape[1]), gathered.dtype)
-        out = out.at[gseg].add(gathered, mode="drop",
-                               indices_are_sorted=True)
-        out = out.reshape(S, b + 1, -1)[:, :b, :].reshape(S * b, -1)
-        if combiner == "mean":
-            counts = jnp.maximum(lengths.reshape(-1), 1).astype(out.dtype)
-            out = out / counts[:, None]
-        return out
-
     def pack_mp_inputs(self, inputs, dtype=None, mesh=None,
                        hots: Optional[Sequence[Any]] = None,
                        local_batch: Optional[int] = None) -> MpInputs:
@@ -655,39 +615,6 @@ class DistributedEmbedding:
         hots_out = tuple(h if k == "d" else ("r", h) for k, h in encs)
         return MpInputs(packed=packed, hots=hots_out, local_batch=b)
 
-    def _lookup_local(self, params: EmbedParams, rank: int,
-                      inputs: Sequence[jax.Array],
-                      flatten_2d: bool) -> List[jax.Array]:
-        """Per-rank local lookups (the hot loop, reference ``:291-294``).
-
-        Gathers run directly on the width slab with row-shifted ids — no table
-        materialization; ids out of the table's range clip inside the slab
-        (callers guarantee in-range ids, as does the reference)."""
-        outs = []
-        for inp, m in zip(inputs, self.strategy.local_map_list[rank]):
-            cfg = self.strategy.local_configs_list[rank][m]
-            k, roff, rows, w = self._table_rows(rank, m)
-            slab = params[k]
-            if isinstance(inp, tuple) and inp[0] == "r":
-                _, values, lengths = inp
-                if values.ndim == 1:
-                    values, lengths = values[None], lengths[None]
-                o = self._ragged_block_combine(
-                    slab, roff, rows, w, values, lengths, cfg.get("combiner"))
-                outs.append(o)
-                continue
-            shifted = jnp.clip(inp, 0, rows - 1) + roff
-            gathered = ps.packed_gather(slab, shifted, w)  # ids.shape + (w,)
-            combiner = cfg.get("combiner")
-            if combiner == "sum":
-                o = jnp.sum(gathered, axis=1)
-            elif combiner == "mean":
-                o = jnp.mean(gathered, axis=1)
-            else:
-                o = gathered
-            outs.append(o.reshape(o.shape[0], -1) if flatten_2d else o)
-        return outs
-
     def __call__(self, params: EmbedParams, inputs) -> List[jax.Array]:
         """Forward pass.
 
@@ -711,19 +638,42 @@ class DistributedEmbedding:
         params = self.local_view(params)
 
         if self.world_size == 1:
+            # Single worker runs the SAME plan-driven lookup, minus the
+            # exchanges: one gather+combine per (width, hotness) group
+            # instead of a per-table loop (tiny zoo: 57 chains -> 4; the
+            # batched ops amortize the per-chain pipeline overheads) and one
+            # shared code path with the distributed executor. Reference
+            # parity of output ranks (``call``, ``:493-500``) is restored
+            # from the plan's flat [b, h*w] slots below.
             if isinstance(inputs, MpInputs):
                 raise ValueError(
                     "world_size == 1 takes a plain input list (mp and dp "
                     "input coincide)")
-            inputs, _, was_1d = self._normalize_inputs(inputs)
-            outs = self._lookup_local(params, 0, inputs, flatten_2d=False)
-            # reference parity: a 1-D no-combiner input yields [batch, width]
-            outs = [o[:, 0, :] if (sq and o.ndim == 3 and o.shape[1] == 1)
-                    else o for o, sq in zip(outs, was_1d)]
-            if self.compute_dtype is not None:
-                # single-worker cast (reference dist_model_parallel.py:499)
-                outs = [o.astype(self.compute_dtype) for o in outs]
-            return outs, ("local", inputs)
+            entries, encs, was_1d = self._normalize_inputs(inputs)
+            b = (entries[0][2].shape[0] if isinstance(entries[0], tuple)
+                 else entries[0].shape[0])
+            comm_dtype = (entries[0][1].dtype if isinstance(entries[0], tuple)
+                          else entries[0].dtype)
+            plan = self._get_plan(encs, b)
+            ids_recv = self._build_send_blocks(plan, entries, comm_dtype)
+            flat_out = self._plan_lookup(plan, params, ids_recv)[0]  # [b, s]
+            outs = []
+            for inst in plan.instances:  # worker order == input order here
+                g = plan.groups[inst.group]
+                c0 = g.col + inst.slot0 * g.width
+                o = lax.slice(flat_out, (0, c0),
+                              (b, c0 + inst.num_slots * g.width))
+                enc = encs[inst.input_id]
+                if (enc[0] == "d" and inst.num_slots > 1):
+                    o = o.reshape(b, inst.num_slots, g.width)
+                elif enc[0] == "d" and not was_1d[inst.input_id] and \
+                        self.strategy.global_configs[
+                            self.strategy.input_table_map[inst.input_id]
+                        ].get("combiner") is None:
+                    o = o.reshape(b, 1, g.width)  # 2-D 1-hot, no combiner
+                outs.append(o)
+            result = [outs[i] for i in self.strategy.rev_global_input_ids]
+            return result, ("dist", ids_recv, tuple(encs), b)
 
         world = self.world_size
         if self.dp_input:
@@ -807,11 +757,21 @@ class DistributedEmbedding:
             self._plan_cache[key] = p
         return p
 
+    def _my_rank(self):
+        """Mesh position under shard_map; static 0 for a single worker
+        (which runs outside any mesh axis)."""
+        return (lax.axis_index(self.axis_name) if self.world_size > 1 else 0)
+
+    def _vary(self, x: jax.Array) -> jax.Array:
+        """VMA-mark a constant when running under shard_map; identity for
+        the single-worker (no mesh axis) path."""
+        return _pvary(x, self.axis_name) if self.world_size > 1 else x
+
     def _plan_row(self, arr: np.ndarray, my) -> jax.Array:
         """This device's row of a ``[world, n]`` plan tensor. The tensor is a
         baked program constant; indexing it by ``lax.axis_index`` is what
         replaces rank-specialized branches."""
-        c = _pvary(jnp.asarray(arr), self.axis_name)
+        c = self._vary(jnp.asarray(arr))
         return lax.dynamic_index_in_dim(c, my, keepdims=False)
 
     def _assemble_cells(self, plan, fill, dead_shape, full_shape, dtype,
@@ -842,7 +802,7 @@ class DistributedEmbedding:
         def dead(shape):
             z = zeros_cache.get(shape)
             if z is None:
-                z = _pvary(jnp.zeros(shape, dtype), self.axis_name)
+                z = self._vary(jnp.zeros(shape, dtype))
                 zeros_cache[shape] = z
             return z
 
@@ -909,7 +869,7 @@ class DistributedEmbedding:
         that no consumer ever slices."""
         world = self.world_size
         b = plan.b
-        my = lax.axis_index(self.axis_name)
+        my = self._my_rank()
         pdt = next(iter(params.values())).dtype
         sections = []
         for gi, g in enumerate(plan.groups):
@@ -946,75 +906,11 @@ class DistributedEmbedding:
             sections.append(
                 red.transpose(0, 2, 1, 3).reshape(world, b, g.n * g.width))
         mp = (jnp.concatenate(sections, axis=2) if sections
-              else _pvary(jnp.zeros((world, b, plan.s_max), pdt),
-                          self.axis_name))
+              else self._vary(jnp.zeros((world, b, plan.s_max), pdt)))
         dt = self.compute_dtype
         return mp.astype(dt) if dt is not None else mp
 
     # ------------------------------------------------------ sparse backward
-
-    def _combiner_backward(self, grad: jax.Array, ids: jax.Array, combiner):
-        """Dense-input combiner backward: per-id gradient rows.
-
-        ``grad`` is ``[n, out_width]``, ``ids`` is ``[n, h]``. Returns
-        ``(flat_ids [n*h], vals [n*h, width])`` — the expansion step of the
-        reference backward (``cc/kernels/embedding_lookup_kernels.cu:493-494``:
-        per-id row ids + 1/len weights for mean).
-        """
-        n, h = ids.shape
-        if not combiner:
-            width = grad.shape[1] // h
-            vals = grad.reshape(n * h, width)
-        elif combiner == "mean":
-            vals = jnp.repeat(grad / h, h, axis=0)
-        else:  # sum
-            vals = jnp.repeat(grad, h, axis=0)
-        return ids.reshape(-1), vals
-
-    def _ragged_combiner_backward(self, grad, values, lengths, combiner):
-        """Ragged-input combiner backward: per-value gradient rows.
-
-        ``grad [S*b, width]`` is the combined output's cotangent; each value
-        position gets its segment's grad row (÷ count for mean). Invalid
-        (padding) positions get id ``-1`` so the caller's range check routes
-        them to the dropped sentinel."""
-        if values.ndim == 1:
-            values, lengths = values[None], lengths[None]
-        S, cap = values.shape
-        b = lengths.shape[1]
-        gseg, valid = self._ragged_segments(cap, lengths)
-        gclip = jnp.clip(gseg, 0, S * b - 1)
-        vals = jnp.take(grad, gclip, axis=0, mode="clip")
-        if combiner == "mean":
-            counts = jnp.maximum(lengths.reshape(-1), 1).astype(vals.dtype)
-            vals = vals / jnp.take(counts, gclip, mode="clip")[:, None]
-        ids = jnp.where(valid, values.reshape(-1), -1)
-        return ids, vals
-
-    def _rank_sparse_update(self, rank: int, params: EmbedParams, opt_state,
-                            parsed_inputs, grads, optimizer, lr, scale):
-        """Apply sparse updates for one rank's tables.
-
-        Ids are shifted into slab-row coordinates and grouped by width, so each
-        width slab takes ONE optimizer scatter per step regardless of how many
-        tables share it. Out-of-table ids are routed to the padding sentinel
-        (slab row capacity) and dropped by the optimizer's scatters."""
-        per_width: Dict[str, List] = {}
-        for j, (inp, grad) in enumerate(zip(parsed_inputs, grads)):
-            m = self.strategy.local_map_list[rank][j]
-            cfg = self.strategy.local_configs_list[rank][m]
-            k, roff, rows, w = self._table_rows(rank, m)
-            cap = self.rows_cap[w]
-            if isinstance(inp, tuple) and inp[0] == "r":
-                ids, vals = self._ragged_combiner_backward(
-                    grad, inp[1], inp[2], cfg.get("combiner"))
-            else:
-                ids, vals = self._combiner_backward(
-                    grad, inp, cfg.get("combiner"))
-            shifted = jnp.where((ids >= 0) & (ids < rows), ids + roff, cap)
-            per_width.setdefault(k, []).append((shifted, vals, w))
-        return self._apply_width_streams(params, opt_state, per_width,
-                                         optimizer, lr, scale)
 
     def _apply_width_streams(self, params: EmbedParams, opt_state,
                              per_width: Dict[str, List], optimizer, lr,
@@ -1087,13 +983,10 @@ class DistributedEmbedding:
         if scale is None:
             scale = 1.0 / self.world_size
 
-        if residuals[0] == "local":
-            _, inputs = residuals
-            grads = [g.reshape(g.shape[0], -1) for g in out_grads]
-            return self._rank_sparse_update(
-                0, params, opt_state, inputs, grads, optimizer, lr, scale)
-
         _, ids_recv, encs, b = residuals
+        # single-worker no-combiner outputs keep their [b, h, w] rank
+        # (reference call semantics); the exchange layout is flat columns
+        out_grads = [g.reshape(g.shape[0], -1) for g in out_grads]
         world = self.world_size
         plan = self._get_plan(list(encs), b)
 
@@ -1133,12 +1026,13 @@ class DistributedEmbedding:
             dead_shape=lambda g: (b, g.width),
             full_shape=(b, plan.s_max), dtype=out_dtype,
             axis=1)  # [world, b, s_max]
-        mp_grad = lax.all_to_all(packed, self.axis_name, 0, 0, tiled=True)
+        mp_grad = (lax.all_to_all(packed, self.axis_name, 0, 0, tiled=True)
+                   if world > 1 else packed)
 
         # Rank-uniform sparse update: per group, rebuild the id stream from
         # the forward's residual block and expand slot cotangents to per-id
         # update rows; per width, one optimizer scatter.
-        my = lax.axis_index(self.axis_name)
+        my = self._my_rank()
         per_width: Dict[str, List] = {}
         for gi, g in enumerate(plan.groups):
             rows = self._plan_row(plan.rows[gi], my)
@@ -1171,8 +1065,8 @@ class DistributedEmbedding:
                     g, b, region, rows, roff, valid)
                 sidx = self._ragged_scatter_idx(g, b, world, seg)
                 gpad = jnp.concatenate(
-                    [gsl, _pvary(jnp.zeros((world, g.n, 1, g.width),
-                                           gsl.dtype), self.axis_name)],
+                    [gsl, self._vary(jnp.zeros((world, g.n, 1, g.width),
+                                               gsl.dtype))],
                     axis=2)  # [world, n, b+1, w]
                 vals = jnp.take(gpad.reshape(-1, g.width), sidx.reshape(-1),
                                 axis=0).reshape(world, g.n, g.hot, g.width)

@@ -72,21 +72,24 @@ def main(_):
     step_fn = make_hybrid_train_step(de, loss_fn, tx, emb_opt, mesh=mesh,
                                      lr_schedule=FLAGS.learning_rate)
 
-    # compile + warmup
+    # compile + warmup; float() readback drains the pipeline — on remote
+    # tunnels block_until_ready can be a no-op (docs/perf_tpu.md Methodology)
     num, cats, labels = gen[0]
     loss, state = step_fn(state, cats, (num, labels))
-    jax.block_until_ready(loss)
     print(f"{model_config.name}: compiled; warmup loss {float(loss):.5f}")
 
     t0 = time.perf_counter()
     for i in range(FLAGS.num_steps):
         num, cats, labels = gen[i]
         loss, state = step_fn(state, cats, (num, labels))
-    jax.block_until_ready(loss)  # collective-forced sync before stopping timer
+    # readback forces the whole threaded-state chain before the timer stops
+    # (the reference stops on an allreduced-loss print the same way,
+    # synthetic_models/main.py:123,138-144 there)
+    final_loss = float(loss)
     dt = (time.perf_counter() - t0) / FLAGS.num_steps
     print(f"{model_config.name}: {dt * 1e3:.3f} ms/iter "
           f"({FLAGS.batch_size / dt:,.0f} samples/s) on {world} device(s), "
-          f"final loss {float(loss):.5f}")
+          f"final loss {final_loss:.5f}")
 
 
 if __name__ == "__main__":

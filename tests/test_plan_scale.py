@@ -1,0 +1,85 @@
+"""Exchange-plan scaling invariants at zoo scale (no compilation).
+
+The round-3 executor's headline property — HLO size independent of
+world x tables — rests on the plan layout: few groups, rank-uniform
+offsets, bounded padding. These tests pin those properties at the scales
+the reference publishes (tiny -> colossal, ``config_v3.py:30-133`` there)
+so a layout regression is caught in milliseconds, not in a 78-second
+colossal compile.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_embeddings_tpu.models import (build_synthetic,
+                                               synthetic_models_v3)
+
+WORLD = 8
+
+
+def build_plan(scale, strategy="memory_balanced"):
+    de, _, hots = build_synthetic(synthetic_models_v3[scale], WORLD,
+                                  strategy=strategy, row_cap=1000)
+    encs = [("d", h) for h in hots]
+    return de, de._get_plan(encs, 64)
+
+
+@pytest.mark.parametrize("scale", ["tiny", "small", "medium", "large",
+                                   "jumbo", "colossal"])
+def test_group_count_stays_small(scale):
+    """Heavy HLO is O(#groups): the zoo's group count must stay O(10)
+    regardless of table count (colossal: 2002 tables)."""
+    de, plan = build_plan(scale)
+    assert len(plan.groups) <= 12, (scale, len(plan.groups))
+    assert len(plan.instances) == sum(
+        len(ids) for ids in de.strategy.input_ids_list)
+
+
+def test_layout_partitions_exactly():
+    """Group regions tile the block and the output row with no gaps or
+    overlaps, and every live instance stays inside its group region."""
+    de, plan = build_plan("colossal")
+    goff = col = 0
+    for g in plan.groups:
+        assert g.goff == goff and g.col == col, (g, goff, col)
+        goff += g.n * g.blen
+        col += g.n * g.width
+    assert plan.l_max == max(goff, 1) and plan.s_max == max(col, 1)
+    for inst in plan.instances:
+        g = plan.groups[inst.group]
+        assert inst.slot0 + inst.num_slots <= g.n
+
+
+def test_plan_tensors_match_strategy():
+    """Per-slot plan rows/roffs agree with the strategy's local configs."""
+    de, plan = build_plan("medium")
+    for r in range(WORLD):
+        seen = 0
+        for inst in plan.instances:
+            if inst.rank != r:
+                continue
+            g = plan.groups[inst.group]
+            m = de.strategy.local_map_list[r][
+                de.strategy.input_ids_list[r].index(inst.input_id)]
+            cfg = de.strategy.local_configs_list[r][m]
+            assert g.width == int(cfg["output_dim"])
+            for k in range(inst.num_slots):
+                assert plan.rows[inst.group][r, inst.slot0 + k] == int(
+                    cfg["input_dim"])
+                assert plan.valid[inst.group][r, inst.slot0 + k] == 1.0
+            seen += 1
+        assert seen == len(de.strategy.input_ids_list[r])
+
+
+@pytest.mark.parametrize("strategy", ["memory_balanced", "comm_balanced"])
+def test_padding_within_bounds(strategy):
+    """Output-exchange padding of the balanced strategies stays below the
+    measured bounds of docs/perf_tpu.md (regression guard, +5pt slack)."""
+    bounds = {"tiny": 0.25, "small": 0.20, "medium": 0.19}
+    for scale, bound in bounds.items():
+        de, plan = build_plan(scale, strategy=strategy)
+        live = np.zeros(WORLD)
+        for inst in plan.instances:
+            live[inst.rank] += plan.out_width(inst)
+        waste = 1 - live.mean() / plan.s_max
+        assert waste <= bound, (strategy, scale, waste)
