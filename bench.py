@@ -50,6 +50,14 @@ CRITEO_KAGGLE_SIZES = [
     8351593, 3194, 27, 14992, 5461306, 10, 5652, 2173, 4, 7046547, 18, 15,
     286181, 105, 142572,
 ]
+# Criteo-1TB (MLPerf DLRM) vocab sizes + the reference's "+1" convention
+# (``examples/dlrm/main.py:68-73`` loads model_size.json and adds 1). This is
+# the model behind BASELINE.md's 8xA100 numbers and the north-star target.
+CRITEO_1TB_SIZES = [s + 1 for s in [
+    39884406, 39043, 17289, 7420, 20263, 3, 7120, 1543, 63, 38532951,
+    2953546, 403346, 10, 2208, 11938, 155, 4, 976, 14, 39979771, 25641295,
+    39664984, 585935, 12972, 108, 36,
+]]
 CAP = 2_000_000
 BATCH = 65536
 BASELINE_SAMPLES_PER_SEC_PER_CHIP = 125_000.0
@@ -213,39 +221,151 @@ def run_tiny_zoo(opt_name):
     return dt * 1e3
 
 
-def v5e16_budget(single_chip_samples_per_sec, num_tables, dim, world=16):
-    """Analytic v5e-16 step-time budget from the measured single-chip step.
+def plan_exchange_bytes(table_sizes, dim, world, b_local, comm_bytes=2,
+                        strategy="memory_balanced"):
+    """Exact per-chip all-to-all bytes of one train step, derived from the
+    executor's own exchange plan (VERDICT r3 Weak #5: the projection must
+    price the plan's *padded* layout, not an idealized formula).
+
+    The id exchange sends ``[world, l_max]`` int32 (this chip keeps its own
+    row: ``(world-1) * l_max`` leaves the chip); the output exchange moves
+    ``[world, b_local, s_max]`` activations forward and the same shape of
+    cotangents back. ``l_max``/``s_max`` come from ``parallel/plan.py`` and
+    include every dead-slot padding column the placement produces.
+    """
+    from distributed_embeddings_tpu.parallel import plan as plan_mod
+    configs = [{"input_dim": int(s), "output_dim": dim}
+               for s in table_sizes]
+    de = DistributedEmbedding(configs, world_size=world, strategy=strategy)
+    plan = plan_mod.build_plan(de.strategy, de.row_offsets_list,
+                               [("d", 1)] * len(table_sizes), b_local)
+    ids_bytes = (world - 1) * plan.l_max * 4
+    out_bytes = 2 * (world - 1) * b_local * plan.s_max * comm_bytes
+    live_cols = sum(plan.out_width(inst) for inst in plan.instances)
+    pad_frac = 1.0 - live_cols / (world * plan.s_max)
+    return ids_bytes + out_bytes, pad_frac, plan
+
+
+def v5e16_budget(single_chip_samples_per_sec, table_sizes, dim, world=16):
+    """v5e-16 step-time budget from the measured single-chip step plus the
+    plan-derived (padding-inclusive) ICI exchange bytes.
 
     Model (see docs/perf_tpu.md "v5e-16 budget"): per-chip compute (dense
     MLP on the 1/world batch shard + embedding lookups/updates for the
     global batch over 1/world of the tables) scales ~1/world from the
     measured single-chip step; on top ride the two all-to-alls (bf16
-    activations fwd + grads bwd) and the int32 id exchange over ICI.
+    activations fwd + grads bwd) and the int32 id exchange over ICI, priced
+    at the executor plan's exact padded layout.
     """
     b_local = BATCH // world
     t_compute = (1.0 / single_chip_samples_per_sec) * BATCH / world
-    a2a_bytes = (
-        2 * (b_local * num_tables * dim * 2) * (world - 1) / world  # fwd+bwd
-        + b_local * num_tables * 4 * (world - 1) / world)           # ids
+    a2a_bytes, pad_frac, _ = plan_exchange_bytes(
+        table_sizes, dim, world, b_local)
     t_ici = a2a_bytes / (V5E_ICI_EFF_GBPS * 1e9)
     t_step = t_compute + t_ici
     return {
         "v5e16_budget_ms": round(t_step * 1e3, 3),
         "v5e16_a2a_mb_per_chip": round(a2a_bytes / 1e6, 2),
+        "v5e16_a2a_padding_frac": round(pad_frac, 4),
         "v5e16_projected_samples_per_sec": round(BATCH / t_step, 0),
     }
 
 
-def _guard(name, fn, default=None):
-    """One failed variant must not kill the whole benchmark report."""
+def run_criteo1tb_shard(world=16):
+    """The north-star model itself (VERDICT r3 Missing #1): one chip runs
+    exactly the embedding work a v5e-16 rank does for DLRM Criteo-1TB —
+    the *heaviest* rank's tables under the world=16 memory_balanced
+    placement, the full global batch of ids (65536), fwd gather + sparse
+    backward + SGD scatter. The placement can't split tables (no column
+    slicing here), so the heaviest rank holds the largest table whole:
+    the 39,979,772-row one, ~10.2 GB bf16 of the model's 48 GB total —
+    every other rank is lighter. The dense half and the ICI exchange are
+    measured/priced separately by the ``criteo1tb_v5e16_*`` terms in
+    :func:`main` (the dense MLP runs data-parallel at batch/world and is
+    the same sub-millisecond cost the Kaggle bench measures).
+
+    Returns ``(samples_per_sec, shard_tables, shard_rows)`` where
+    samples_per_sec = global batch / measured embedding step time.
+    """
+    de16 = DistributedEmbedding(
+        [{"input_dim": int(s), "output_dim": 128}
+         for s in CRITEO_1TB_SIZES], world_size=world,
+        strategy="memory_balanced")
+    loads = [sum(int(c["input_dim"]) * int(c["output_dim"]) for c in cfgs)
+             for cfgs in de16.strategy.local_configs_list]
+    r = int(np.argmax(loads))
+    shard_sizes = [int(c["input_dim"])
+                   for c in de16.strategy.local_configs_list[r]]
+
+    cfg = make_cfg(shard_sizes, jnp.bfloat16)
+    de = DistributedEmbedding(cfg.embedding_configs(), world_size=1,
+                              compute_dtype=jnp.bfloat16)
+    emb_opt = SparseSGD()
+    rng = np.random.default_rng(0)
+    cats = [jnp.asarray(power_law_ids(rng, s, (BATCH,)), jnp.int32)
+            for s in shard_sizes]
+    params = de.init(jax.random.key(0), dtype=jnp.bfloat16)
+
+    def emb_step(params, cats_, _unused):
+        outs, res = de.forward_with_residuals(params, cats_)
+        # unit cotangents: gradient VALUES don't change the routing/scatter
+        # work; the dense half that would produce them is timed separately
+        ogs = [jnp.full_like(o, 1e-3) for o in outs]
+        new_params, _ = de.sparse_apply_gradients(
+            params, (), res, ogs, emb_opt, 0.005, scale=1.0)
+        loss = outs[0].astype(jnp.float32)[0, 0]
+        return loss, new_params
+
+    step = jax.jit(emb_step, donate_argnums=(0,))
+    dt = timed_loop(step, params, (cats, None), iters=16)
+    return BATCH / dt, len(shard_sizes), sum(shard_sizes)
+
+
+def _guard(name, fn, default=None, retries=1):
+    """One failed variant must not kill the whole benchmark report; a
+    transient tunnel/compile error gets one retry (VERDICT r3 Weak #1 —
+    r3 lost its tiny-zoo Adagrad capture to a dropped remote_compile
+    connection that a retry would have recovered)."""
     import traceback
-    try:
-        return fn()
-    except Exception:  # noqa: BLE001 - report and continue
-        import sys
-        print(f"[bench] variant {name} failed:", file=sys.stderr)
-        traceback.print_exc()
-        return default
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except Exception:  # noqa: BLE001 - report and continue
+            import sys
+            print(f"[bench] variant {name} failed "
+                  f"(attempt {attempt + 1}/{retries + 1}):", file=sys.stderr)
+            traceback.print_exc()
+    return default
+
+
+def run_dense_only(batch):
+    """DLRMDense fwd/bwd/SGD step time (ms) at a per-chip batch — the dense
+    term of the v5e-16 1TB budget (embedding activations enter as data)."""
+    cfg = make_cfg([100] * 26, jnp.bfloat16)
+    dense = DLRMDense(cfg)
+    tx = optax.sgd(0.005)
+    rng = np.random.default_rng(0)
+    num = jnp.asarray(rng.normal(size=(batch, 13)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 2, size=(batch, 1)), jnp.float32)
+    embs = [jnp.asarray(rng.normal(size=(batch, 128)), jnp.bfloat16)
+            for _ in range(26)]
+    params = dense.init(jax.random.key(0), num[:2], [e[:2] for e in embs])
+    opt_state = tx.init(params)
+
+    def step(state, embs_, batch_):
+        params, opt_state = state
+        n, y = batch_
+
+        def loss_fn(p):
+            return bce_with_logits(dense.apply(p, n, embs_), y)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return loss, (optax.apply_updates(params, updates), opt_state)
+
+    dt = timed_loop(jax.jit(step, donate_argnums=(0,)),
+                    (params, opt_state), (embs, (num, labels)), iters=30)
+    return dt * 1e3
 
 
 def main():
@@ -253,7 +373,14 @@ def main():
     cfg_probe = make_cfg(capped, jnp.bfloat16)
 
     fp32 = _guard("fp32", lambda: run_dlrm(capped, jnp.float32), 0.0)
-    bf16 = _guard("bf16", lambda: run_dlrm(capped, jnp.bfloat16), 0.0)
+    # headline is median-of-3 (VERDICT r3 Weak #1: single runs drifted
+    # 2.6% between rounds; the spread is now part of the record)
+    bf16_runs = [x for x in [
+        _guard(f"bf16_{i}", lambda: run_dlrm(capped, jnp.bfloat16))
+        for i in range(3)] if x]
+    bf16 = float(np.median(bf16_runs)) if bf16_runs else 0.0
+    bf16_spread = (round((max(bf16_runs) - min(bf16_runs)) / bf16, 4)
+                   if len(bf16_runs) > 1 and bf16 else None)
     # full Criteo-Kaggle vocabs, bf16 tables (~8.3 GB) — no cap
     uncapped_bf16 = _guard(
         "uncapped_bf16",
@@ -265,6 +392,10 @@ def main():
     # compiles on the CPU backend); samples/s is batch-insensitive here.
     ragged = _guard("multihot_ragged", lambda: run_dlrm(
         capped, jnp.bfloat16, ragged_hotness=15, batch=16384))
+    # the north-star model itself: heaviest v5e-16 rank shard of
+    # Criteo-1TB, global batch of ids, bf16 (VERDICT r3 Missing #1)
+    c1tb = _guard("criteo1tb_shard", lambda: run_criteo1tb_shard())
+    dense_ms = _guard("dense_only", lambda: run_dense_only(BATCH // 16))
     tiny_adagrad_ms = _guard("tiny_adagrad",
                              lambda: run_tiny_zoo("adagrad"))
     tiny_sgd_ms = _guard("tiny_sgd", lambda: run_tiny_zoo("sgd"))
@@ -284,6 +415,8 @@ def main():
         "variant": "bf16" if bf16 >= fp32 else "fp32",
         "fp32_samples_per_sec": round(fp32, 1),
         "bf16_samples_per_sec": round(bf16, 1),
+        "bf16_median_of": len(bf16_runs),
+        "bf16_spread_frac": bf16_spread,
         "uncapped_bf16_samples_per_sec": r(uncapped_bf16),
         "multihot_ragged_samples_per_sec": r(ragged),
         "multihot_mean_hotness": 15.5,
@@ -297,8 +430,26 @@ def main():
             None if tiny_adagrad_ms is None
             else round(24.433 / tiny_adagrad_ms, 3)),
     }
+    if c1tb is not None:
+        c1tb_sps, shard_tables, shard_rows = c1tb
+        out["criteo1tb_shard_samples_per_sec"] = round(c1tb_sps, 1)
+        out["criteo1tb_shard_tables"] = shard_tables
+        out["criteo1tb_shard_rows"] = shard_rows
+        if dense_ms is not None:
+            # v5e-16 step on the 1TB model: measured heaviest-rank embedding
+            # step + measured dense step at batch/16 + plan-derived ICI term
+            a2a_bytes, pad_frac, _ = plan_exchange_bytes(
+                CRITEO_1TB_SIZES, 128, 16, BATCH // 16)
+            t = (BATCH / c1tb_sps + dense_ms / 1e3
+                 + a2a_bytes / (V5E_ICI_EFF_GBPS * 1e9))
+            out["criteo1tb_dense_ms_at_b4096"] = round(dense_ms, 2)
+            out["criteo1tb_v5e16_step_ms"] = round(t * 1e3, 3)
+            out["criteo1tb_v5e16_a2a_mb_per_chip"] = round(a2a_bytes / 1e6, 2)
+            out["criteo1tb_v5e16_a2a_padding_frac"] = round(pad_frac, 4)
+            out["criteo1tb_v5e16_projected_samples_per_sec"] = round(
+                BATCH / t, 0)
     if best > 0:
-        out.update(v5e16_budget(best, len(capped), cfg_probe.embedding_dim))
+        out.update(v5e16_budget(best, capped, cfg_probe.embedding_dim))
     print(json.dumps(out))
 
 

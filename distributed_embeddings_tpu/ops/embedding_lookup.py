@@ -116,9 +116,21 @@ def ragged_row_ids(row_splits: jax.Array, capacity: int) -> jax.Array:
     Equivalent of the reference's ``OffsetToWeightsAndRowId`` device function
     (``cc/kernels/embedding_lookup_kernels.cu:352-361``), minus the weights
     (see :func:`distributed_embeddings_tpu.ops.sparse_grad.combiner_grad_values`).
+
+    Implementation: scatter a 1 at each row's *end* offset, then prefix-sum —
+    ``seg[p] = #\\{rows ending at or before p\\}``. O(capacity) streaming work.
+    The obvious ``searchsorted(row_splits, positions)`` form lowers to a
+    per-position binary-search loop that measured **~1.0 s** at the DCNv2
+    bench shapes (26 features x 256k positions) where this form runs the
+    whole decode in ~15 ms — the single biggest ragged-path cost found in
+    round 4 (docs/perf_tpu.md, phase table).
     """
-    positions = jnp.arange(capacity, dtype=row_splits.dtype)
-    return jnp.searchsorted(row_splits, positions, side="right") - 1
+    ends = row_splits[1:].astype(jnp.int32)
+    marks = jnp.zeros((capacity + 1,), jnp.int32)
+    # ends ascend (cumulative offsets): sorted-scatter fast path applies
+    marks = marks.at[jnp.clip(ends, 0, capacity)].add(
+        1, indices_are_sorted=True)
+    return jnp.cumsum(marks[:capacity]).astype(row_splits.dtype)
 
 
 def _ragged_combine(params: jax.Array, values: jax.Array, row_splits: jax.Array,

@@ -838,19 +838,24 @@ class DistributedEmbedding:
             plan, fill, dead_shape=lambda g: (g.blen,),
             full_shape=(plan.l_max,), dtype=comm_dtype, axis=0)
 
-    def _ragged_decode(self, g, b: int, region, rows, roff, valid):
+    def _ragged_decode(self, g, b: int, region, rows, roff, valid,
+                       need_counts: bool = True):
         """Decode one ragged group region ``[world, n*(cap+b)]`` into
         ``(values, lengths, seg, grow, counts)``, all ``[world, n, ...]``.
         Dead slots get zero lengths, so every position routes to the dropped
-        segment ``b``."""
+        segment ``b``. ``valid=None`` means every slot is statically live
+        (skips the mask multiply); ``need_counts=False`` skips the
+        mean-divisor counts (sum-only groups never read them)."""
         world = self.world_size
         r3 = region.reshape(world, g.n, g.blen)
         values = r3[:, :, :g.hot]
-        lengths = r3[:, :, g.hot:] * valid[None, :, None].astype(r3.dtype)
+        lengths = r3[:, :, g.hot:]
+        if valid is not None:
+            lengths = lengths * valid[None, :, None].astype(r3.dtype)
         _, seg = self._csr_seg(lengths, g.hot)
         grow = (jnp.clip(values, 0, (rows - 1)[None, :, None])
                 + roff[None, :, None])
-        counts = jnp.maximum(lengths, 1)
+        counts = jnp.maximum(lengths, 1) if need_counts else None
         return values, lengths, seg, grow, counts
 
     @staticmethod
@@ -876,7 +881,12 @@ class DistributedEmbedding:
             slab = params[_wkey(g.width)]
             rows = self._plan_row(plan.rows[gi], my)
             roff = self._plan_row(plan.roff[gi], my)
-            mean = self._plan_row(plan.mean[gi], my)
+            # mean/valid are *static* plan tensors: when no slot on any rank
+            # is a mean combiner (resp. dead), the divide (resp. mask) is
+            # skipped at trace time — sum-only groups never touch counts
+            any_mean = bool(plan.mean[gi].any())
+            all_mean = bool(plan.mean[gi].all())
+            all_valid = bool((plan.valid[gi] > 0).all())
             region = lax.slice(ids_recv, (0, g.goff),
                                (world, g.goff + g.n * g.blen))
             if g.kind == "d":
@@ -885,13 +895,18 @@ class DistributedEmbedding:
                         + roff[None, :, None, None])
                 gath = ps.packed_gather(slab, grow, g.width)
                 red = jnp.sum(gath, axis=3)  # [world, n, b, w]
-                if g.hot > 1:
-                    red = jnp.where(mean[None, :, None, None] > 0,
-                                    red / g.hot, red)
+                if g.hot > 1 and any_mean:
+                    if all_mean:
+                        red = red / g.hot
+                    else:
+                        mean = self._plan_row(plan.mean[gi], my)
+                        red = jnp.where(mean[None, :, None, None] > 0,
+                                        red / g.hot, red)
             else:
                 _, _, seg, grow, counts = self._ragged_decode(
                     g, b, region, rows, roff,
-                    self._plan_row(plan.valid[gi], my))
+                    None if all_valid else self._plan_row(plan.valid[gi], my),
+                    need_counts=any_mean)
                 gath = ps.packed_gather(slab, grow, g.width)  # [w, n, cap, ww]
                 sidx = self._ragged_scatter_idx(g, b, world, seg)
                 buf = jnp.zeros((world * g.n * (b + 1), g.width), gath.dtype)
@@ -900,9 +915,14 @@ class DistributedEmbedding:
                 buf = buf.at[sidx.reshape(-1)].add(
                     gath.reshape(-1, g.width), indices_are_sorted=True)
                 red = buf.reshape(world, g.n, b + 1, g.width)[:, :, :b, :]
-                red = jnp.where(mean[None, :, None, None] > 0,
-                                red / counts[..., None].astype(red.dtype),
-                                red)
+                if any_mean:
+                    div = red / counts[..., None].astype(red.dtype)
+                    if all_mean:
+                        red = div
+                    else:
+                        mean = self._plan_row(plan.mean[gi], my)
+                        red = jnp.where(mean[None, :, None, None] > 0,
+                                        div, red)
             sections.append(
                 red.transpose(0, 2, 1, 3).reshape(world, b, g.n * g.width))
         mp = (jnp.concatenate(sections, axis=2) if sections
@@ -1037,8 +1057,11 @@ class DistributedEmbedding:
         for gi, g in enumerate(plan.groups):
             rows = self._plan_row(plan.rows[gi], my)
             roff = self._plan_row(plan.roff[gi], my)
-            valid = self._plan_row(plan.valid[gi], my)
-            mean = self._plan_row(plan.mean[gi], my)
+            any_mean = bool(plan.mean[gi].any())
+            all_mean = bool(plan.mean[gi].all())
+            all_valid = bool((plan.valid[gi] > 0).all())
+            valid = (None if all_valid
+                     else self._plan_row(plan.valid[gi], my))
             sent = self.rows_cap[g.width]  # dropped-row sentinel (logical)
             region = lax.slice(ids_recv, (0, g.goff),
                                (world, g.goff + g.n * g.blen))
@@ -1050,19 +1073,25 @@ class DistributedEmbedding:
                 # out-of-range ids were clipped in the forward (safety net)
                 # but are dropped here: a bad id trains nothing (see module
                 # docstring contract)
-                ok = ((ids4 >= 0) & (ids4 < rows[None, :, None, None])
-                      & (valid[None, :, None, None] > 0))
+                ok = (ids4 >= 0) & (ids4 < rows[None, :, None, None])
+                if valid is not None:
+                    ok = ok & (valid[None, :, None, None] > 0)
                 ids = jnp.where(ok, ids4 + roff[None, :, None, None], sent)
                 gb = gsl
-                if g.hot > 1:
-                    gb = jnp.where(mean[None, :, None, None] > 0,
-                                   gsl / g.hot, gsl)
+                if g.hot > 1 and any_mean:
+                    if all_mean:
+                        gb = gsl / g.hot
+                    else:
+                        mean = self._plan_row(plan.mean[gi], my)
+                        gb = jnp.where(mean[None, :, None, None] > 0,
+                                       gsl / g.hot, gsl)
                 vals = jnp.broadcast_to(
                     gb[:, :, :, None, :],
                     (world, g.n, b, g.hot, g.width))
             else:
                 values, _, seg, _, counts = self._ragged_decode(
-                    g, b, region, rows, roff, valid)
+                    g, b, region, rows, roff, valid,
+                    need_counts=any_mean)
                 sidx = self._ragged_scatter_idx(g, b, world, seg)
                 gpad = jnp.concatenate(
                     [gsl, self._vary(jnp.zeros((world, g.n, 1, g.width),
@@ -1070,16 +1099,22 @@ class DistributedEmbedding:
                     axis=2)  # [world, n, b+1, w]
                 vals = jnp.take(gpad.reshape(-1, g.width), sidx.reshape(-1),
                                 axis=0).reshape(world, g.n, g.hot, g.width)
-                cpad = jnp.concatenate(
-                    [counts, jnp.ones((world, g.n, 1), counts.dtype)], axis=2)
-                cval = jnp.take(cpad.reshape(-1), sidx.reshape(-1)
-                                ).reshape(world, g.n, g.hot)
-                vals = jnp.where(mean[None, :, None, None] > 0,
-                                 vals / cval[..., None].astype(vals.dtype),
-                                 vals)
-                ok = ((seg < b) & (values >= 0)
-                      & (values < rows[None, :, None])
-                      & (valid[None, :, None] > 0))
+                if any_mean:
+                    cpad = jnp.concatenate(
+                        [counts, jnp.ones((world, g.n, 1), counts.dtype)],
+                        axis=2)
+                    cval = jnp.take(cpad.reshape(-1), sidx.reshape(-1)
+                                    ).reshape(world, g.n, g.hot)
+                    div = vals / cval[..., None].astype(vals.dtype)
+                    if all_mean:
+                        vals = div
+                    else:
+                        mean = self._plan_row(plan.mean[gi], my)
+                        vals = jnp.where(mean[None, :, None, None] > 0,
+                                         div, vals)
+                ok = (seg < b) & (values >= 0) & (values < rows[None, :, None])
+                if valid is not None:
+                    ok = ok & (valid[None, :, None] > 0)
                 ids = jnp.where(ok, values + roff[None, :, None], sent)
             per_width.setdefault(_wkey(g.width), []).append(
                 (ids, vals, g.width))

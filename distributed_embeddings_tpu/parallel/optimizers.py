@@ -47,7 +47,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.packed_slab import expand_lane_mask
+from ..ops.packed_slab import expand_lane_mask, pack_factor
 from ..ops.sparse_grad import dedup_sparse_grad
 
 
@@ -114,6 +114,15 @@ def _dedup_with_mask(ids, vals, mask, lane_width, pad_id):
     out of the state transition — a zero gradient cannot encode "untouched"
     (a touched row may legitimately have zero gradient)."""
     if mask is None:
+        if lane_width is not None and pack_factor(lane_width) > 1:
+            # without the mask, summed duplicate physical rows would count
+            # packed *neighbour* logical rows as touched and corrupt their
+            # momentum/moment state (ADVICE r3) — refuse rather than corrupt
+            raise ValueError(
+                f"lane_width={lane_width} is a packed width "
+                f"(p={pack_factor(lane_width)}) but no lane touch-mask was "
+                "given; build one with ops.packed_slab.lane_one_hot(ids, "
+                "lane_width) or omit lane_width only for widths >= 128")
         uids, uvals = dedup_sparse_grad(ids, vals, pad_id=pad_id,
                                         max_unique=pad_id + 1)
         return uids, uvals, None

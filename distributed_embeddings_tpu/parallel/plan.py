@@ -136,6 +136,13 @@ def build_plan(strategy, row_offsets_list: Sequence[Sequence[int]],
                     key = ("d", w, 1)
                     entries = [(rows, roff, 1.0, 0.0)] * int(param)
             else:
+                if comb is None:
+                    # without this, a combiner-less table would silently get
+                    # the mean-flag 0.0, i.e. 'sum' semantics (ADVICE r3)
+                    raise ValueError(
+                        f"Input {i} is Ragged but table "
+                        f"{strategy.input_table_map[i]} has no combiner; "
+                        "ragged features require combiner='sum' or 'mean'")
                 key = ("r", w, int(param))
                 entries = [(rows, roff, 1.0, 1.0 if comb == "mean" else 0.0)]
             slots = key_slots.setdefault(key, [[] for _ in range(world)])

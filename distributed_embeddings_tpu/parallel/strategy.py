@@ -121,7 +121,15 @@ def apply_strategy(mode: str, world_size: int,
         table_hots: Dict[int, Counter] = defaultdict(Counter)
         for i, tid in enumerate(itm):
             table_hots[tid][int(hot[i])] += 1
-        # slice footprint: slots contributed per (width, hotness) group
+        # slice footprint: slots contributed per (width, hotness) group.
+        # NOTE (ADVICE r3): slice widths are modeled by flat position, but
+        # DistEmbeddingStrategy hands a table's slices to ranks FIFO in rank
+        # order, so when the width remainder spreads base+1 columns over the
+        # first slices, the slice a rank receives can be one column narrower/
+        # wider than the one this objective counted. Bounded by one column
+        # per (table, rank) pair — noise next to the padding term — so the
+        # modeling error is accepted rather than threading slice identity
+        # through the assignment.
         items = []
         for pos, (tid, size, w) in enumerate(
                 zip(flat_ids, flat_sizes, flat_widths)):

@@ -1,0 +1,119 @@
+"""End-to-end phase split of the ragged DLRM step (VERDICT r3 Weak #2/#4).
+
+Splits the bench's ragged variant into dispatch overhead / embedding fwd /
+dense fwd+bwd / sparse apply by timing nested subsets with the threaded-
+state + readback methodology of bench.py.
+
+Usage: python tools/profile_step.py [ragged|dense] [batch]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, ".")
+from bench import (BATCH, CRITEO_KAGGLE_SIZES, CAP, build_state, make_cfg,
+                   timed_loop)
+from distributed_embeddings_tpu.models.dlrm import DLRMDense, bce_with_logits
+from distributed_embeddings_tpu.ops.embedding_lookup import Ragged
+from distributed_embeddings_tpu.parallel import (
+    DistributedEmbedding, SparseSGD, make_hybrid_train_step)
+from distributed_embeddings_tpu.utils import power_law_ids
+
+
+def main():
+    variant = sys.argv[1] if len(sys.argv) > 1 else "ragged"
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else (
+        16384 if variant == "ragged" else BATCH)
+    table_sizes = [min(s, CAP) for s in CRITEO_KAGGLE_SIZES]
+    cfg = make_cfg(table_sizes, jnp.bfloat16)
+    combiner = "sum" if variant == "ragged" else None
+    de = DistributedEmbedding(cfg.embedding_configs(combiner=combiner),
+                              world_size=1, compute_dtype=jnp.bfloat16)
+    dense = DLRMDense(cfg)
+    emb_opt = SparseSGD()
+    tx = optax.sgd(0.005)
+
+    rng = np.random.default_rng(0)
+    if variant == "ragged":
+        draws = []
+        for s in table_sizes:
+            hots = rng.integers(1, 31, size=batch)
+            splits = np.zeros(batch + 1, np.int32)
+            np.cumsum(hots, out=splits[1:])
+            draws.append((s, splits))
+        cap = max(int(sp[-1]) for _, sp in draws)
+        cats = []
+        for s, splits in draws:
+            nnz = int(splits[-1])
+            vals = np.zeros(cap, np.int32)
+            vals[:nnz] = power_law_ids(rng, s, (nnz,))
+            cats.append(Ragged(values=jnp.asarray(vals),
+                               row_splits=jnp.asarray(splits)))
+    else:
+        cats = [jnp.asarray(power_law_ids(rng, s, (batch,)), jnp.int32)
+                for s in table_sizes]
+
+    state, num, labels = build_state(de, dense, cfg, emb_opt, tx,
+                                     table_sizes, jnp.float32, batch=batch)
+
+    def loss_fn(dp, emb_outs, batch_):
+        n, y = batch_
+        return bce_with_logits(dense.apply(dp, n, emb_outs), y)
+
+    # --- 0: dispatch floor (trivial jitted fn, threaded) ------------------
+    @jax.jit
+    def trivial(s, cats_, b_):
+        return s.reshape(-1)[0] * 1.0001, s
+
+    sl = state.emb_params["_w128"] if "_w128" in state.emb_params else \
+        next(iter(state.emb_params.values()))
+    dt0 = timed_loop(trivial, sl, (cats, (num, labels)), iters=12)
+    print(f"dispatch floor: {dt0*1e3:.1f} ms", flush=True)
+
+    # --- 1: embedding forward only ---------------------------------------
+    @jax.jit
+    def fwd_only(emb_params, cats_, b_):
+        outs, _ = de.forward_with_residuals(emb_params, cats_)
+        # thread: tie a scalar from outputs back into params to serialize
+        bump = outs[0].astype(jnp.float32)[0, 0] * 1e-12
+        p2 = {k: v + bump for k, v in emb_params.items()}
+        return outs[0].astype(jnp.float32)[0, 0], p2
+
+    dt1 = timed_loop(fwd_only, dict(state.emb_params),
+                     (cats, (num, labels)), iters=8)
+    print(f"embedding fwd: {dt1*1e3:.1f} ms (minus dispatch "
+          f"{dt0*1e3:.0f})", flush=True)
+
+    # --- 2: fwd + dense fwd/bwd (no sparse apply) -------------------------
+    @jax.jit
+    def fwd_dense(packed, cats_, batch_):
+        emb_params, dp = packed
+        outs, _ = de.forward_with_residuals(emb_params, cats_)
+        loss, (dg, og) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+            dp, outs, batch_)
+        bump = (loss * 1e-12).astype(jnp.float32)
+        p2 = {k: v + bump for k, v in emb_params.items()}
+        return loss, (p2, dp)
+
+    dt2 = timed_loop(fwd_dense, (dict(state.emb_params), state.dense_params),
+                     (cats, (num, labels)), iters=8)
+    print(f"fwd + dense f/b: {dt2*1e3:.1f} ms", flush=True)
+
+    # --- 3: full step -----------------------------------------------------
+    step_fn = make_hybrid_train_step(de, loss_fn, tx, emb_opt,
+                                     lr_schedule=0.005)
+    dt3 = timed_loop(step_fn, state, (cats, (num, labels)), iters=8)
+    print(f"full step: {dt3*1e3:.1f} ms -> {batch/dt3:.0f} samples/s",
+          flush=True)
+    print(f"phases: dispatch {dt0*1e3:.0f} | emb fwd {(dt1-dt0)*1e3:.0f} | "
+          f"dense f/b {(dt2-dt1)*1e3:.0f} | sparse apply "
+          f"{(dt3-dt2)*1e3:.0f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
