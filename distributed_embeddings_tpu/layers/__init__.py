@@ -2,3 +2,4 @@
 ``distributed_embeddings/python/layers/``)."""
 
 from .embedding import ConcatEmbedding, Embedding
+from .dist_flax import DistributedEmbeddingLayer

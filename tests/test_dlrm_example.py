@@ -1,0 +1,59 @@
+"""DLRM example script smoke: mid-training eval cadence + AUC early stop
+(VERDICT r3 Missing #3) driven end-to-end through ``examples/dlrm/main.py``
+on an 8-virtual-device CPU mesh (via the script's DETPU_FORCE_CPU_DEVICES
+test hook)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SCRIPT = os.path.join(_REPO, "examples", "dlrm", "main.py")
+
+
+def _run(tmp_path, extra):
+    env = dict(os.environ)
+    env["DETPU_FORCE_CPU_DEVICES"] = "8"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [_REPO] + env.get("PYTHONPATH", "").split(os.pathsep))
+    cmd = [
+        sys.executable, _SCRIPT,
+        "--batch_size", "64",
+        "--table_sizes", ",".join(["50"] * 10),
+        "--embedding_dim", "8",
+        "--bottom_mlp_dims", "16,8",
+        "--top_mlp_dims", "16,1",
+        "--num_numerical_features", "4",
+        "--learning_rate", "0.1",
+        "--checkpoint_out", str(tmp_path / "ckpt"),
+    ] + extra
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=600)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return proc.stdout
+
+
+def test_eval_interval_and_early_stop(tmp_path):
+    out = _run(tmp_path, [
+        "--num_batches", "8",
+        "--eval_interval", "3",
+        "--eval_batches", "2",
+        "--auc_threshold", "0.0",  # any AUC satisfies: must stop at step 3
+    ])
+    assert "eval step: 3 AUC:" in out, out
+    assert "threshold 0.0 reached at step 3" in out, out
+    # early stop means the end-of-training eval must NOT run
+    assert "Evaluation completed" not in out, out
+
+
+@pytest.mark.slow
+def test_final_eval_and_checkpoint(tmp_path):
+    out = _run(tmp_path, [
+        "--num_batches", "4",
+        "--eval_interval", "0",
+        "--eval_batches", "2",
+    ])
+    assert "Evaluation completed, AUC:" in out, out
+    assert "saved 10 tables" in out, out

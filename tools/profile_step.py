@@ -75,33 +75,52 @@ def main():
     dt0 = timed_loop(trivial, sl, (cats, (num, labels)), iters=12)
     print(f"dispatch floor: {dt0*1e3:.1f} ms", flush=True)
 
+    # Phases 1-2 thread a small token through the *inputs* (ids depend on
+    # the previous iteration's output scalar) so dispatches can't
+    # short-circuit, while params stay read-only — threading the params
+    # themselves (v + bump) was measured to distort the phase by seconds.
+    def _dep_cats(cats_, tok):
+        bump = (tok * 0).astype(jnp.int32)
+
+        def dep(c):
+            if hasattr(c, "values"):  # Ragged
+                return type(c)(values=c.values + bump,
+                               row_splits=c.row_splits)
+            return c + bump
+        return [dep(c) for c in cats_]
+
     # --- 1: embedding forward only ---------------------------------------
     @jax.jit
-    def fwd_only(emb_params, cats_, b_):
-        outs, _ = de.forward_with_residuals(emb_params, cats_)
-        # thread: tie a scalar from outputs back into params to serialize
-        bump = outs[0].astype(jnp.float32)[0, 0] * 1e-12
-        p2 = {k: v + bump for k, v in emb_params.items()}
-        return outs[0].astype(jnp.float32)[0, 0], p2
+    def fwd_only(tok, emb_params, cats_):
+        outs, _ = de.forward_with_residuals(emb_params,
+                                            _dep_cats(cats_, tok))
+        tok2 = outs[0].astype(jnp.float32)[0, 0]
+        return tok2, tok2
 
-    dt1 = timed_loop(fwd_only, dict(state.emb_params),
-                     (cats, (num, labels)), iters=8)
+    # params are read-only in phases 1-2: reuse state's slabs (a second
+    # de.init copy would double embedding HBM and can OOM)
+    emb_params = state.emb_params
+    dt1 = timed_loop(fwd_only, jnp.float32(0), (emb_params, cats), iters=8)
     print(f"embedding fwd: {dt1*1e3:.1f} ms (minus dispatch "
           f"{dt0*1e3:.0f})", flush=True)
 
     # --- 2: fwd + dense fwd/bwd (no sparse apply) -------------------------
     @jax.jit
-    def fwd_dense(packed, cats_, batch_):
-        emb_params, dp = packed
-        outs, _ = de.forward_with_residuals(emb_params, cats_)
+    def fwd_dense(tok, emb_params, dp, cats_, batch_):
+        outs, _ = de.forward_with_residuals(emb_params,
+                                            _dep_cats(cats_, tok))
         loss, (dg, og) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
             dp, outs, batch_)
-        bump = (loss * 1e-12).astype(jnp.float32)
-        p2 = {k: v + bump for k, v in emb_params.items()}
-        return loss, (p2, dp)
+        # the backward must feed the output or XLA dead-code-eliminates it
+        gsum = sum(jnp.sum(g.astype(jnp.float32)) for g in og)
+        gsum = gsum + jax.tree.reduce(
+            lambda a, g: a + jnp.sum(g.astype(jnp.float32)), dg, 0.0)
+        tok2 = loss + gsum * 1e-12
+        return tok2, tok2
 
-    dt2 = timed_loop(fwd_dense, (dict(state.emb_params), state.dense_params),
-                     (cats, (num, labels)), iters=8)
+    dt2 = timed_loop(fwd_dense, jnp.float32(0),
+                     (emb_params, state.dense_params, cats, (num, labels)),
+                     iters=8)
     print(f"fwd + dense f/b: {dt2*1e3:.1f} ms", flush=True)
 
     # --- 3: full step -----------------------------------------------------
