@@ -42,7 +42,8 @@ from distributed_embeddings_tpu.models.dlrm import (
     DLRMConfig, DLRMDense, bce_with_logits)
 from distributed_embeddings_tpu.ops.embedding_lookup import Ragged
 from distributed_embeddings_tpu.parallel import (
-    DistributedEmbedding, HybridTrainState, SparseSGD, make_hybrid_train_step)
+    DistributedEmbedding, HybridTrainState, SparseSGD,
+    make_hybrid_train_loop, make_hybrid_train_step)
 from distributed_embeddings_tpu.utils import power_law_ids
 
 CRITEO_KAGGLE_SIZES = [
@@ -60,6 +61,10 @@ CRITEO_1TB_SIZES = [s + 1 for s in [
 ]]
 CAP = 2_000_000
 BATCH = 65536
+# steps scanned per dispatch by each variant's loop driver (see run_dlrm)
+DLRM_STEPS_PER_CALL = 8
+ZOO_STEPS_PER_CALL = 4
+C1TB_STEPS_PER_CALL = 4
 BASELINE_SAMPLES_PER_SEC_PER_CHIP = 125_000.0
 # TPU v5e (v5 lite): 197 TFLOP/s bf16 peak, 819 GB/s HBM, ~100 GB/s
 # effective per-chip all-to-all bandwidth over ICI (2D torus, 4x 400 Gbps
@@ -74,14 +79,19 @@ def timed_loop(step, state, args, iters=24, warmup=3):
     loss = None
     for _ in range(warmup):
         loss, state = step(state, *args)
-    float(loss)  # drain the pipeline before starting the clock
+    _force(loss)  # drain the pipeline before starting the clock
     t0 = time.perf_counter()
     for _ in range(iters):
         loss, state = step(state, *args)
-    float(loss)  # forces execution of the whole chain (tunnel-safe)
+    _force(loss)  # forces execution of the whole chain (tunnel-safe)
     dt = (time.perf_counter() - t0) / iters
     del state
     return dt
+
+
+def _force(x):
+    """Readback of one element (loop drivers return a [K] loss vector)."""
+    return float(jnp.asarray(x).reshape(-1)[-1])
 
 
 def dense_flops_per_sample(cfg, num_tables):
@@ -135,10 +145,20 @@ def build_state(de, dense, cfg, emb_opt, tx, table_sizes, param_dtype,
 
 
 def run_dlrm(table_sizes, compute_dtype, param_dtype=jnp.float32,
-             ragged_hotness=None, batch=None):
+             ragged_hotness=None, batch=None,
+             steps_per_call=DLRM_STEPS_PER_CALL):
     """One DLRM variant; returns samples/s. ``ragged_hotness`` switches the
-    26 features to variable-hotness Ragged inputs with that mean hotness."""
+    26 features to variable-hotness Ragged inputs with that mean hotness.
+
+    Timing drives ``steps_per_call`` distinct pre-staged batches through ONE
+    compiled program per dispatch (``make_hybrid_train_loop``'s ``lax.scan``)
+    — per-step host dispatch measured ~25 ms through this environment's
+    device tunnel (about a quarter of the r3 headline step), an artifact a
+    production input pipeline amortizes exactly this way.
+    ``steps_per_call=1`` restores the per-step-dispatch methodology of
+    rounds 1-3."""
     batch = BATCH if batch is None else batch
+    K = steps_per_call
     combiner = "sum" if ragged_hotness else None
     cfg = make_cfg(table_sizes, compute_dtype)
     de = DistributedEmbedding(cfg.embedding_configs(combiner=combiner),
@@ -149,8 +169,9 @@ def run_dlrm(table_sizes, compute_dtype, param_dtype=jnp.float32,
 
     rng = np.random.default_rng(0)
     if ragged_hotness is None:
-        cats = [jnp.asarray(power_law_ids(rng, s, (batch,)), jnp.int32)
-                for s in table_sizes]
+        cat_stacks = [
+            jnp.asarray(power_law_ids(rng, s, (K, batch)), jnp.int32)
+            for s in table_sizes]
     else:
         # near-exact capacity: the reference's dynamic ragged carries no
         # padding, so minimal static headroom is the fair equivalent (every
@@ -160,36 +181,48 @@ def run_dlrm(table_sizes, compute_dtype, param_dtype=jnp.float32,
         # (width, capacity) group — one gather + one combine total.
         draws = []
         for s in table_sizes:
-            hots = rng.integers(1, 2 * ragged_hotness + 1, size=batch)
-            splits = np.zeros(batch + 1, np.int32)
-            np.cumsum(hots, out=splits[1:])
+            hots = rng.integers(1, 2 * ragged_hotness + 1, size=(K, batch))
+            splits = np.zeros((K, batch + 1), np.int32)
+            np.cumsum(hots, axis=1, out=splits[:, 1:])
             draws.append((s, splits))
-        cap = max(int(sp[-1]) for _, sp in draws)
-        cats = []
+        cap = int(max(sp[:, -1].max() for _, sp in draws))
+        cat_stacks = []
         for s, splits in draws:
-            nnz = int(splits[-1])
-            vals = np.zeros(cap, np.int32)
-            vals[:nnz] = power_law_ids(rng, s, (nnz,))
-            cats.append(Ragged(values=jnp.asarray(vals),
-                               row_splits=jnp.asarray(splits)))
+            vals = np.zeros((K, cap), np.int32)
+            for k in range(K):
+                nnz = int(splits[k, -1])
+                vals[k, :nnz] = power_law_ids(rng, s, (nnz,))
+            cat_stacks.append(Ragged(values=jnp.asarray(vals),
+                                     row_splits=jnp.asarray(splits)))
 
     state, num, labels = build_state(de, dense, cfg, emb_opt, tx,
                                      table_sizes, param_dtype, batch=batch)
+    num_stack = jnp.broadcast_to(num, (K,) + num.shape)
+    lab_stack = jnp.broadcast_to(labels, (K,) + labels.shape)
 
     def loss_fn(dp, emb_outs, batch):
         n, y = batch
         return bce_with_logits(dense.apply(dp, n, emb_outs), y)
 
-    step_fn = make_hybrid_train_step(de, loss_fn, tx, emb_opt,
+    if K == 1:
+        step_fn = make_hybrid_train_step(de, loss_fn, tx, emb_opt,
+                                         lr_schedule=0.005)
+        cats1 = jax.tree.map(lambda a: a[0], cat_stacks)
+        dt = timed_loop(step_fn, state, (cats1, (num, labels)))
+        return batch / dt
+    loop_fn = make_hybrid_train_loop(de, loss_fn, tx, emb_opt,
                                      lr_schedule=0.005)
-    dt = timed_loop(step_fn, state, (cats, (num, labels)))
-    return batch / dt
+    dt = timed_loop(loop_fn, state,
+                    (cat_stacks, (num_stack, lab_stack)), iters=4)
+    return batch * K / dt
 
 
-def run_tiny_zoo(opt_name):
+def run_tiny_zoo(opt_name, steps_per_call=ZOO_STEPS_PER_CALL):
     """Synthetic `tiny` zoo model (55 tables, 4.3 GB uncapped, batch 65536)
     — BASELINE.md's main table; the reference's 1xA100 Adagrad number is
-    24.433 ms/iter (`synthetic_models/README.md:69`)."""
+    24.433 ms/iter (`synthetic_models/README.md:69`). Multi-step scanned
+    dispatch like :func:`run_dlrm` (per-step tunnel dispatch is ~25 ms —
+    12%+ of this step — and not a property of the program)."""
     from distributed_embeddings_tpu.models import (
         InputGenerator, build_synthetic, synthetic_models_v3)
     from distributed_embeddings_tpu.parallel import (
@@ -197,12 +230,16 @@ def run_tiny_zoo(opt_name):
 
     mc = synthetic_models_v3["tiny"]
     de, dense, _ = build_synthetic(mc, 1)
-    gen = InputGenerator(mc, BATCH, alpha=1.05, num_batches=1)
+    K = steps_per_call
+    gen = InputGenerator(mc, BATCH, alpha=1.05, num_batches=K)
     if opt_name == "adagrad":
         emb_opt, tx = SparseAdagrad(), optax.adagrad(0.01)
     else:
         emb_opt, tx = SparseSGD(), optax.sgd(0.01)
-    num, cats, labels = gen[0]
+    batches = [gen[k] for k in range(K)]
+    num, cats, labels = batches[0]
+    stack = jax.tree.map(lambda *xs: jnp.stack(xs), *batches)
+    num_stack, cat_stacks, lab_stack = stack
     out_widths = [int(de.strategy.global_configs[t]["output_dim"])
                   for t in de.strategy.input_table_map]
     dense_params = dense.init(
@@ -215,10 +252,11 @@ def run_tiny_zoo(opt_name):
 
     state = init_hybrid_state(de, emb_opt, dense_params, tx,
                               jax.random.key(1))
-    step_fn = make_hybrid_train_step(de, loss_fn, tx, emb_opt,
+    loop_fn = make_hybrid_train_loop(de, loss_fn, tx, emb_opt,
                                      lr_schedule=0.01)
-    dt = timed_loop(step_fn, state, (cats, (num, labels)), iters=15)
-    return dt * 1e3
+    dt = timed_loop(loop_fn, state,
+                    (cat_stacks, (num_stack, lab_stack)), iters=4)
+    return dt / K * 1e3
 
 
 def plan_exchange_bytes(table_sizes, dim, world, b_local, comm_bytes=2,
@@ -301,24 +339,28 @@ def run_criteo1tb_shard(world=16):
     de = DistributedEmbedding(cfg.embedding_configs(), world_size=1,
                               compute_dtype=jnp.bfloat16)
     emb_opt = SparseSGD()
+    K = C1TB_STEPS_PER_CALL
     rng = np.random.default_rng(0)
-    cats = [jnp.asarray(power_law_ids(rng, s, (BATCH,)), jnp.int32)
-            for s in shard_sizes]
+    cat_stacks = [jnp.asarray(power_law_ids(rng, s, (K, BATCH)), jnp.int32)
+                  for s in shard_sizes]
     params = de.init(jax.random.key(0), dtype=jnp.bfloat16)
 
-    def emb_step(params, cats_, _unused):
+    def emb_body(params, cats_):
         outs, res = de.forward_with_residuals(params, cats_)
         # unit cotangents: gradient VALUES don't change the routing/scatter
         # work; the dense half that would produce them is timed separately
         ogs = [jnp.full_like(o, 1e-3) for o in outs]
         new_params, _ = de.sparse_apply_gradients(
             params, (), res, ogs, emb_opt, 0.005, scale=1.0)
-        loss = outs[0].astype(jnp.float32)[0, 0]
-        return loss, new_params
+        return new_params, outs[0].astype(jnp.float32)[0, 0]
 
-    step = jax.jit(emb_step, donate_argnums=(0,))
-    dt = timed_loop(step, params, (cats, None), iters=16)
-    return BATCH / dt, len(shard_sizes), sum(shard_sizes)
+    def emb_loop(params, cat_stacks_):
+        params, toks = jax.lax.scan(emb_body, params, cat_stacks_)
+        return toks, params
+
+    step = jax.jit(emb_loop, donate_argnums=(0,))
+    dt = timed_loop(step, params, (cat_stacks,), iters=4)
+    return BATCH * K / dt, len(shard_sizes), sum(shard_sizes)
 
 
 def _guard(name, fn, default=None, retries=1):
@@ -381,6 +423,10 @@ def main():
     bf16 = float(np.median(bf16_runs)) if bf16_runs else 0.0
     bf16_spread = (round((max(bf16_runs) - min(bf16_runs)) / bf16, 4)
                    if len(bf16_runs) > 1 and bf16 else None)
+    # rounds 1-3 comparability: one capture with per-step dispatch
+    bf16_per_dispatch = _guard(
+        "bf16_per_dispatch",
+        lambda: run_dlrm(capped, jnp.bfloat16, steps_per_call=1))
     # full Criteo-Kaggle vocabs, bf16 tables (~8.3 GB) — no cap
     uncapped_bf16 = _guard(
         "uncapped_bf16",
@@ -417,6 +463,10 @@ def main():
         "bf16_samples_per_sec": round(bf16, 1),
         "bf16_median_of": len(bf16_runs),
         "bf16_spread_frac": bf16_spread,
+        "bf16_per_dispatch_samples_per_sec": r(bf16_per_dispatch),
+        "steps_per_call": {"dlrm": DLRM_STEPS_PER_CALL,
+                           "tiny_zoo": ZOO_STEPS_PER_CALL,
+                           "criteo1tb": C1TB_STEPS_PER_CALL},
         "uncapped_bf16_samples_per_sec": r(uncapped_bf16),
         "multihot_ragged_samples_per_sec": r(ragged),
         "multihot_mean_hotness": 15.5,

@@ -21,5 +21,6 @@ from .trainer import (
     HybridTrainState,
     init_hybrid_state,
     make_hybrid_eval_step,
+    make_hybrid_train_loop,
     make_hybrid_train_step,
 )

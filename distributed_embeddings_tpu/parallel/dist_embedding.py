@@ -53,6 +53,7 @@ reference reaches through its custom kernel (``embedding_lookup_ops.py:79-80``).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -1067,28 +1068,38 @@ class DistributedEmbedding:
                                (world, g.goff + g.n * g.blen))
             gsl = lax.slice(mp_grad, (0, 0, g.col),
                             (world, b, g.col + g.n * g.width))
-            gsl = gsl.reshape(world, b, g.n, g.width).transpose(0, 2, 1, 3)
+            gsl = gsl.reshape(world, b, g.n, g.width)
             if g.kind == "d":
-                ids4 = region.reshape(world, g.n, b, g.hot)
+                # b-major stream: the value rows are then exactly the
+                # [world, b, n, w] grad layout — a FREE reshape of the
+                # exchange row instead of a materialized transpose (the
+                # [b, n*w] -> [n, b, w] copy + cast measured ~26 ms at the
+                # DLRM headline shapes); only the small int id tensor
+                # transposes. The optimizer sorts the stream anyway, so
+                # stream order is free to choose (docs/perf_tpu.md r4).
+                ids4 = region.reshape(world, g.n, b, g.hot
+                                      ).transpose(0, 2, 1, 3)
                 # out-of-range ids were clipped in the forward (safety net)
                 # but are dropped here: a bad id trains nothing (see module
                 # docstring contract)
-                ok = (ids4 >= 0) & (ids4 < rows[None, :, None, None])
+                ok = (ids4 >= 0) & (ids4 < rows[None, None, :, None])
                 if valid is not None:
-                    ok = ok & (valid[None, :, None, None] > 0)
-                ids = jnp.where(ok, ids4 + roff[None, :, None, None], sent)
+                    ok = ok & (valid[None, None, :, None] > 0)
+                ids = jnp.where(ok, ids4 + roff[None, None, :, None], sent)
                 gb = gsl
                 if g.hot > 1 and any_mean:
                     if all_mean:
                         gb = gsl / g.hot
                     else:
                         mean = self._plan_row(plan.mean[gi], my)
-                        gb = jnp.where(mean[None, :, None, None] > 0,
+                        gb = jnp.where(mean[None, None, :, None] > 0,
                                        gsl / g.hot, gsl)
                 vals = jnp.broadcast_to(
                     gb[:, :, :, None, :],
-                    (world, g.n, b, g.hot, g.width))
+                    (world, b, g.n, g.hot, g.width))
             else:
+                gsl = gsl.transpose(0, 2, 1, 3)  # ragged sidx layout is
+                # (source, slot, row): one small copy, the take absorbs it
                 values, _, seg, _, counts = self._ragged_decode(
                     g, b, region, rows, roff, valid,
                     need_counts=any_mean)
@@ -1143,14 +1154,19 @@ class DistributedEmbedding:
             plan.append(rank_plan)
         return plan
 
-    def _fetch_rows(self, v, rank: int, start: int, n: int) -> np.ndarray:
+    def _fetch_rows(self, v, rank: int, start: int, n: int,
+                    to_host: bool = True) -> Optional[np.ndarray]:
         """Host copy of ``v[rank, start:start+n, :]`` without materializing
         anything bigger. For non-addressable shards (multi-host) the slice is
         jit-extracted with a fully-replicated out-sharding — the chunked
         allgather of the reference's ``get_weights``
-        (``dist_model_parallel.py:441-447``) — so every process gets it."""
+        (``dist_model_parallel.py:441-447``) — so every process gets it.
+        ``to_host=False`` still executes the collective fetch (every process
+        must, SPMD) but skips the device->host copy and returns ``None``
+        (the ``all_ranks=False`` mode of :meth:`get_weights`)."""
         if isinstance(v, np.ndarray):
-            return np.asarray(v[rank, start:start + n, :])
+            return np.asarray(v[rank, start:start + n, :]) if to_host \
+                else None
         w = v.shape[2]
         if v.is_fully_addressable:
             # Slice on the owning shard's device — a single-device program
@@ -1166,7 +1182,8 @@ class DistributedEmbedding:
                     fn = jax.jit(lambda a, r, s: lax.dynamic_slice(
                         a, (r, s, 0), (1, n, w))[0])
                     self._ckpt_jit_cache[key] = fn
-                return np.asarray(fn(shard.data, rank - r0, start))
+                res = fn(shard.data, rank - r0, start)
+                return np.asarray(res) if to_host else None
             raise AssertionError("fully-addressable array with no owner shard")
         # Multi-host: every process needs the chunk but no process holds all
         # shards. A masked psum inside shard_map moves exactly one chunk over
@@ -1192,28 +1209,40 @@ class DistributedEmbedding:
                 local, mesh=mesh, in_specs=(P(axis), P(), P()),
                 out_specs=P()))
             self._ckpt_jit_cache[key] = fn
-        return np.asarray(fn(v, jnp.asarray(rank), jnp.asarray(start)))
+        res = fn(v, jnp.asarray(rank), jnp.asarray(start))
+        return np.asarray(res) if to_host else None
 
     def get_weights(self, params: EmbedParams,
-                    chunk_elems: int = CHECKPOINT_CHUNK_ELEMS
-                    ) -> List[np.ndarray]:
+                    chunk_elems: int = CHECKPOINT_CHUNK_ELEMS,
+                    all_ranks: bool = True) -> Optional[List[np.ndarray]]:
         """Reassemble the full (unsliced) global tables on host, streaming
         row chunks of at most ``chunk_elems`` elements.
 
         Equivalent of the reference's chunked-allgather ``get_weights``
         (``dist_model_parallel.py:411-485``): peak transient host memory is
         one chunk, not one model; tables over 2^31 elements stream fine; on
-        multi-host meshes every process receives the full tables (the
-        reference's ``all_ranks=True``)."""
+        multi-host meshes every process receives the full tables by default.
+
+        Args:
+          all_ranks: with ``False`` (the reference's rank-0-only mode,
+            ``dist_model_parallel.py:411,419``) only process 0 assembles and
+            returns the tables; other processes still participate in every
+            collective fetch (SPMD requires it) but skip the device->host
+            copy and the host-side buffers, and return ``None``. On a pod
+            this keeps the full-model host footprint confined to the
+            checkpoint-writing process.
+        """
         if not hasattr(self, "_ckpt_jit_cache"):
             self._ckpt_jit_cache = {}
+        is_chief = jax.process_index() == 0
+        keep = all_ranks or is_chief
         params = self.stacked_view(params)
         out: List[Optional[np.ndarray]] = (
             [None] * len(self.strategy.global_configs))
         for r, rank_plan in enumerate(self._slice_plan()):
             for tid, roff, rows, c0, w in rank_plan:
                 v = params[_wkey(w)]
-                if out[tid] is None:
+                if keep and out[tid] is None:
                     full_w = int(
                         self.strategy.global_configs[tid]["output_dim"])
                     out[tid] = np.empty((rows, full_w), v.dtype)
@@ -1222,10 +1251,11 @@ class DistributedEmbedding:
                 for s in range(0, rows, chunk_rows):
                     n = min(chunk_rows, rows - s)
                     phys = self._fetch_rows(
-                        v, r, (roff + s) // p, -(-n // p))
-                    out[tid][s:s + n, c0:c0 + w] = ps.unpack_rows_np(
-                        phys, w)[:n]
-        return out
+                        v, r, (roff + s) // p, -(-n // p), to_host=keep)
+                    if keep:
+                        out[tid][s:s + n, c0:c0 + w] = ps.unpack_rows_np(
+                            phys, w)[:n]
+        return out if keep else None
 
     def _build_shard(self, loaded, dev, width: int, r0: int, r1: int,
                      dtype, chunk_elems: int) -> jax.Array:
@@ -1268,10 +1298,20 @@ class DistributedEmbedding:
 
     def set_weights(self, weights: Sequence[Any], mesh=None,
                     dtype=jnp.float32,
-                    chunk_elems: int = CHECKPOINT_CHUNK_ELEMS) -> EmbedParams:
+                    chunk_elems: int = CHECKPOINT_CHUNK_ELEMS,
+                    use_lock: bool = False) -> EmbedParams:
         """Build the sharded slab dict from full global tables (numpy arrays
         or ``np.load``-able paths, mmap'd like the reference,
         ``dist_model_parallel.py:337-339``).
+
+        ``use_lock=True`` serializes the host-side shard building across
+        processes *on the same machine* with a file lock — the reference's
+        ``set_weights(..., use_lock=True)`` (``dist_model_parallel.py:331``),
+        for loading models whose per-process transient host footprint could
+        not otherwise coexist. The streaming chunked design mostly obviates
+        it (peak transient host memory is one chunk), but page-cache
+        pressure from several processes mmap-reading the same checkpoint
+        can still merit serialization.
 
         Streams per-slice row chunks directly into per-device shard buffers
         — the reference's 128M-element chunked ``scatter_update``
@@ -1291,19 +1331,35 @@ class DistributedEmbedding:
                 # dynamic_update_slice — reject shape drift up front
                 raise ValueError(
                     f"Table {tid}: expected shape {want}, got {src.shape}")
-        out = {}
-        for w in self.widths:
-            if mesh is None:
-                # honor an active jax.default_device context (e.g. staging a
-                # bigger-than-HBM model on host), like the old asarray path
-                dev = jax.config.jax_default_device or jax.devices()[0]
-                if isinstance(dev, str):  # context also accepts platform names
-                    dev = jax.devices(dev)[0]
-                out[_wkey(w)] = self._build_shard(
-                    loaded, dev, w, 0, self.world_size, dtype, chunk_elems)
-                continue
-            out[_wkey(w)] = self._assemble_sharded(
-                mesh, w,
-                lambda dev, r0, r1, w=w: self._build_shard(
-                    loaded, dev, w, r0, r1, dtype, chunk_elems))
+
+        lock_file = None
+        if use_lock:
+            import fcntl
+            import tempfile
+            lock_file = open(os.path.join(
+                tempfile.gettempdir(), "detpu_set_weights.lock"), "w")
+            fcntl.flock(lock_file, fcntl.LOCK_EX)
+        try:
+            out = {}
+            for w in self.widths:
+                if mesh is None:
+                    # honor an active jax.default_device context (e.g.
+                    # staging a bigger-than-HBM model on host), like the old
+                    # asarray path
+                    dev = jax.config.jax_default_device or jax.devices()[0]
+                    if isinstance(dev, str):  # context also accepts
+                        dev = jax.devices(dev)[0]  # platform names
+                    out[_wkey(w)] = self._build_shard(
+                        loaded, dev, w, 0, self.world_size, dtype,
+                        chunk_elems)
+                    continue
+                out[_wkey(w)] = self._assemble_sharded(
+                    mesh, w,
+                    lambda dev, r0, r1, w=w: self._build_shard(
+                        loaded, dev, w, r0, r1, dtype, chunk_elems))
+        finally:
+            if lock_file is not None:
+                import fcntl
+                fcntl.flock(lock_file, fcntl.LOCK_UN)
+                lock_file.close()
         return out

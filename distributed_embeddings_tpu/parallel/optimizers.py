@@ -51,6 +51,31 @@ from ..ops.packed_slab import expand_lane_mask, pack_factor
 from ..ops.sparse_grad import dedup_sparse_grad
 
 
+# Explicit-sort scatter pays off only below this stream length. Measured on
+# v5e (docs/perf_tpu.md round-4 table): at 1.7M rows into a 5.4 GB slab the
+# unsorted scatter runs 38.5 ms where sort+fused-permute+sorted-scatter runs
+# ~18 (the isolated pre-sorted scatter is 5.9) — but at 2.9M (tiny zoo) and
+# 6.6M (DCNv2 ragged) rows the explicit sort+permute chain costs MORE than
+# XLA's internal unsorted lowering (+31 / +16 ms end-to-end).
+_SORT_STREAM_MAX = 2_000_000
+
+
+def _sorted_scatter_add(slab: jax.Array, ids: jax.Array,
+                        vals: jax.Array) -> jax.Array:
+    """``slab.at[ids].add(vals)``, sorting the id keys first when the stream
+    is short enough for the explicit sort to win (see ``_SORT_STREAM_MAX``):
+    keys sort at 3.4 ns/key, the value permute rides the scatter as a fused
+    gather operand, and the scatter declares sortedness."""
+    n = ids.shape[0]
+    if n > _SORT_STREAM_MAX:
+        return slab.at[ids].add(vals, mode="drop")
+    sorted_ids, perm = lax.sort_key_val(
+        ids, jnp.arange(n, dtype=jnp.int32))
+    upd = jnp.take(vals, perm, axis=0)  # fuses into the scatter
+    return slab.at[sorted_ids].add(upd, mode="drop",
+                                   indices_are_sorted=True)
+
+
 class SparseSGD:
     """Plain SGD on slab rows; duplicate ids accumulate via scatter-add."""
 
@@ -60,7 +85,8 @@ class SparseSGD:
     def apply_rows(self, slab: jax.Array, state, ids: jax.Array,
                    vals: jax.Array, lr):
         """``slab[ids] -= lr * vals``; ids >= slab rows are dropped."""
-        slab = slab.at[ids].add(-lr * vals.astype(slab.dtype), mode="drop")
+        slab = _sorted_scatter_add(slab, ids,
+                                   -lr * vals.astype(slab.dtype))
         return slab, state
 
 
@@ -105,8 +131,7 @@ class SparseAdagrad:
                 and vals.shape[0] * self.dense_apply_ratio > slab.shape[0]):
             # dense-apply regime: one scatter-sum, then elementwise Adagrad
             # over the slab (exact — untouched rows see g=0, a no-op)
-            g = jnp.zeros_like(slab).at[ids].add(
-                vals.astype(slab.dtype), mode="drop")
+            g = _sorted_scatter_add(jnp.zeros_like(slab), ids, vals)
             new_acc = accum + g * g
             slab = slab - lr * g * lax.rsqrt(new_acc + self.eps)
             return slab, new_acc

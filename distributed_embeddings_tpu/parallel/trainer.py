@@ -28,6 +28,41 @@ from .dist_embedding import DistributedEmbedding
 from .grads import resolve_dp_gradient
 
 
+def _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer, lr_schedule,
+                       state, cat_inputs, batch):
+    """One per-device hybrid step (shared by :func:`make_hybrid_train_step`
+    and :func:`make_hybrid_train_loop`): forward, one backward producing dp
+    gradients (pmean-averaged) and mp cotangents (manual sparse path), both
+    optimizer updates, step counter bump."""
+    world = de.world_size
+    # slabs are {width: [world, rows, w]} globally -> [rows, w] per device
+    emb_local = de.local_view(state.emb_params)
+    emb_opt_local = de.local_view(state.emb_opt_state)
+    outs, res = de.forward_with_residuals(emb_local, cat_inputs)
+
+    loss, (dense_grads, out_grads) = jax.value_and_grad(
+        loss_fn, argnums=(0, 1))(state.dense_params, outs, batch)
+    if world > 1:
+        loss = lax.pmean(loss, de.axis_name)
+        dense_grads = jax.tree.map(
+            lambda g: resolve_dp_gradient(g, de.axis_name), dense_grads)
+
+    lr = lr_schedule(state.step) if callable(lr_schedule) else lr_schedule
+    emb_local, emb_opt_local = de.sparse_apply_gradients(
+        emb_local, emb_opt_local, res, out_grads, emb_optimizer, lr)
+
+    updates, dense_opt_state = dense_tx.update(
+        dense_grads, state.dense_opt_state, state.dense_params)
+    dense_params = optax.apply_updates(state.dense_params, updates)
+
+    new_state = HybridTrainState(
+        emb_params=de.stacked_view(emb_local),
+        emb_opt_state=de.stacked_view(emb_opt_local),
+        dense_params=dense_params, dense_opt_state=dense_opt_state,
+        step=state.step + 1)
+    return loss, new_state
+
+
 class HybridTrainState(NamedTuple):
     """All mutable training state. ``emb_params``/``emb_opt_state`` are the
     model-parallel slab dicts ``{width: [world, phys_rows, phys_width]}``
@@ -67,32 +102,8 @@ def make_hybrid_train_step(de: DistributedEmbedding,
     world = de.world_size
 
     def local_step(state: HybridTrainState, cat_inputs, batch):
-        # slabs are {width: [world, rows, w]} globally -> [rows, w] per device
-        emb_local = de.local_view(state.emb_params)
-        emb_opt_local = de.local_view(state.emb_opt_state)
-        outs, res = de.forward_with_residuals(emb_local, cat_inputs)
-
-        loss, (dense_grads, out_grads) = jax.value_and_grad(
-            loss_fn, argnums=(0, 1))(state.dense_params, outs, batch)
-        if world > 1:
-            loss = lax.pmean(loss, de.axis_name)
-            dense_grads = jax.tree.map(
-                lambda g: resolve_dp_gradient(g, de.axis_name), dense_grads)
-
-        lr = lr_schedule(state.step) if callable(lr_schedule) else lr_schedule
-        emb_local, emb_opt_local = de.sparse_apply_gradients(
-            emb_local, emb_opt_local, res, out_grads, emb_optimizer, lr)
-
-        updates, dense_opt_state = dense_tx.update(
-            dense_grads, state.dense_opt_state, state.dense_params)
-        dense_params = optax.apply_updates(state.dense_params, updates)
-
-        new_state = HybridTrainState(
-            emb_params=de.stacked_view(emb_local),
-            emb_opt_state=de.stacked_view(emb_opt_local),
-            dense_params=dense_params, dense_opt_state=dense_opt_state,
-            step=state.step + 1)
-        return loss, new_state
+        return _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer,
+                                  lr_schedule, state, cat_inputs, batch)
 
     if world == 1:
         return jax.jit(local_step, donate_argnums=(0,))
@@ -107,6 +118,64 @@ def make_hybrid_train_step(de: DistributedEmbedding,
     sm = jax.shard_map(
         local_step, mesh=mesh,
         in_specs=(state_specs, P(ax), P(ax)),
+        out_specs=(P(), state_specs))
+    return jax.jit(sm, donate_argnums=(0,))
+
+
+def make_hybrid_train_loop(de: DistributedEmbedding,
+                           loss_fn: Callable,
+                           dense_tx: optax.GradientTransformation,
+                           emb_optimizer,
+                           mesh=None,
+                           lr_schedule=1.0,
+                           unroll: int = 1):
+    """Multi-step training driver: ``loop(state, cat_stacks, batch_stacks)
+    -> (losses [K], state)`` running K steps inside ONE compiled program via
+    ``lax.scan``.
+
+    Per-step host dispatch costs real wall-clock (through this repo's
+    benchmark tunnel it measured ~25 ms/step — 25% of the DLRM headline
+    step); production TPU input pipelines amortize it by driving several
+    steps per dispatch. Inputs carry a leading scan axis K: each categorical
+    input ``[K, local_batch, ...]`` (Ragged: values ``[K, cap]``, row_splits
+    ``[K, b+1]``), ``batch`` any pytree with leading K.
+
+    The per-step semantics (gradients, optimizer updates, step counter) are
+    exactly :func:`make_hybrid_train_step`'s — same ``local_step`` body.
+    """
+    world = de.world_size
+
+    def body(state, xs):
+        cat_inputs, batch = xs
+        loss, state = _hybrid_local_step(
+            de, loss_fn, dense_tx, emb_optimizer, lr_schedule, state,
+            cat_inputs, batch)
+        return state, loss
+
+    if world == 1:
+        def loop(state, cat_stacks, batch_stacks):
+            state, losses = lax.scan(body, state, (cat_stacks, batch_stacks),
+                                     unroll=unroll)
+            return losses, state
+        return jax.jit(loop, donate_argnums=(0,))
+
+    if mesh is None:
+        raise ValueError("mesh is required for world_size > 1")
+    ax = de.axis_name
+    state_specs = HybridTrainState(
+        emb_params=P(ax), emb_opt_state=P(ax),
+        dense_params=P(), dense_opt_state=P(), step=P())
+
+    def local_loop(state, cat_stacks, batch_stacks):
+        # same body as world == 1 (_hybrid_local_step already pmeans the
+        # loss and resolves dp gradients for world > 1)
+        state, losses = lax.scan(body, state, (cat_stacks, batch_stacks),
+                                 unroll=unroll)
+        return losses, state
+
+    sm = jax.shard_map(
+        local_loop, mesh=mesh,
+        in_specs=(state_specs, P(None, ax), P(None, ax)),
         out_specs=(P(), state_specs))
     return jax.jit(sm, donate_argnums=(0,))
 

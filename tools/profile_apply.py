@@ -50,7 +50,10 @@ def main():
         u = rng.random(B)
         ids_np[i] = np.minimum((u ** 3 * s).astype(np.int64), s - 1) + offs[i]
     ids = jnp.asarray(ids_np.reshape(-1).astype(np.int32))  # [N*B]
-    slab = jnp.zeros((rows_total, W), jnp.float32) + 0.5
+
+    def fresh_slab():  # each phase donates (and so deletes) its slab
+        return jnp.zeros((rows_total, W), jnp.float32) + 0.5
+
     vals_bf16 = jnp.zeros((N * B, W), jnp.bfloat16) + 1e-3
 
     # (a) raw scatter, fp32 updates
@@ -63,7 +66,7 @@ def main():
             return s, sl
         return f
     print(f"raw SGD scatter ({N*B} rows): "
-          f"{slope_donate(mk_a, (slab, ids, vals_bf16)):.1f} ms", flush=True)
+          f"{slope_donate(mk_a, (fresh_slab(), ids, vals_bf16)):.1f} ms", flush=True)
 
     # (b) scatter from per-feature grad slices [N, B, W] bf16 with the
     # backward's broadcast/transpose/concat glue in front
@@ -80,7 +83,7 @@ def main():
             return s, sl
         return f
     print("scatter + transpose/cast glue: "
-          f"{slope_donate(mk_b, (slab, ids, grad)):.1f} ms", flush=True)
+          f"{slope_donate(mk_b, (fresh_slab(), ids, grad)):.1f} ms", flush=True)
 
     # (c) sorted-scatter comparison (pre-sorted ids, same payload)
     order = np.argsort(ids_np.reshape(-1), kind="stable")
@@ -96,7 +99,7 @@ def main():
             return s, sl
         return f
     print("pre-sorted scatter: "
-          f"{slope_donate(mk_c, (slab, ids_s, vals_bf16)):.1f} ms",
+          f"{slope_donate(mk_c, (fresh_slab(), ids_s, vals_bf16)):.1f} ms",
           flush=True)
 
 
