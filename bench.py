@@ -346,13 +346,16 @@ def run_criteo1tb_shard(world=16):
     params = de.init(jax.random.key(0), dtype=jnp.bfloat16)
 
     def emb_body(params, cats_):
-        outs, res = de.forward_with_residuals(params, cats_)
+        local = de.local_view(params)
+        outs, res = de.forward_with_residuals(local, cats_)
         # unit cotangents: gradient VALUES don't change the routing/scatter
         # work; the dense half that would produce them is timed separately
         ogs = [jnp.full_like(o, 1e-3) for o in outs]
-        new_params, _ = de.sparse_apply_gradients(
-            params, (), res, ogs, emb_opt, 0.005, scale=1.0)
-        return new_params, outs[0].astype(jnp.float32)[0, 0]
+        new_local, _ = de.sparse_apply_gradients(
+            local, (), res, ogs, emb_opt, 0.005, scale=1.0)
+        # restore the stacked [world, ...] layout so the scan carry type
+        # matches its input
+        return de.stacked_view(new_local), outs[0].astype(jnp.float32)[0, 0]
 
     def emb_loop(params, cat_stacks_):
         params, toks = jax.lax.scan(emb_body, params, cat_stacks_)
@@ -415,14 +418,20 @@ def main():
     cfg_probe = make_cfg(capped, jnp.bfloat16)
 
     fp32 = _guard("fp32", lambda: run_dlrm(capped, jnp.float32), 0.0)
-    # headline is median-of-3 (VERDICT r3 Weak #1: single runs drifted
-    # 2.6% between rounds; the spread is now part of the record)
-    bf16_runs = [x for x in [
-        _guard(f"bf16_{i}", lambda: run_dlrm(capped, jnp.bfloat16))
+    # rounds 1-3 comparable capture: bf16 compute over fp32 tables
+    bf16 = _guard("bf16", lambda: run_dlrm(capped, jnp.bfloat16), 0.0)
+    # headline candidate: bf16 tables too (the reference's headline is AMP —
+    # fp16 storage/compute — examples/dlrm/README.md:8; bf16 needs no loss
+    # scaling on TPU). Median-of-3 (VERDICT r3 Weak #1: single runs drifted
+    # 2.6% between rounds; the spread is now part of the record).
+    bf16p_runs = [x for x in [
+        _guard(f"bf16_params_{i}",
+               lambda: run_dlrm(capped, jnp.bfloat16,
+                                param_dtype=jnp.bfloat16))
         for i in range(3)] if x]
-    bf16 = float(np.median(bf16_runs)) if bf16_runs else 0.0
-    bf16_spread = (round((max(bf16_runs) - min(bf16_runs)) / bf16, 4)
-                   if len(bf16_runs) > 1 and bf16 else None)
+    bf16p = float(np.median(bf16p_runs)) if bf16p_runs else 0.0
+    bf16p_spread = (round((max(bf16p_runs) - min(bf16p_runs)) / bf16p, 4)
+                    if len(bf16p_runs) > 1 and bf16p else None)
     # rounds 1-3 comparability: one capture with per-step dispatch
     bf16_per_dispatch = _guard(
         "bf16_per_dispatch",
@@ -445,11 +454,12 @@ def main():
     tiny_adagrad_ms = _guard("tiny_adagrad",
                              lambda: run_tiny_zoo("adagrad"))
     tiny_sgd_ms = _guard("tiny_sgd", lambda: run_tiny_zoo("sgd"))
-    best = max(fp32, bf16)
+    best = max(fp32, bf16, bf16p)
 
     flops = dense_flops_per_sample(cfg_probe, len(capped))
-    ebytes = embedding_hbm_bytes_per_sample(len(capped),
-                                            cfg_probe.embedding_dim)
+    ebytes = embedding_hbm_bytes_per_sample(
+        len(capped), cfg_probe.embedding_dim,
+        param_bytes=2 if best == bf16p else 4)
     def r(x, nd=1):
         return None if x is None else round(x, nd)
 
@@ -458,11 +468,13 @@ def main():
         "value": round(best, 1),
         "unit": "samples/s",
         "vs_baseline": round(best / BASELINE_SAMPLES_PER_SEC_PER_CHIP, 3),
-        "variant": "bf16" if bf16 >= fp32 else "fp32",
+        "variant": ("bf16_params" if best == bf16p
+                    else "bf16" if best == bf16 else "fp32"),
         "fp32_samples_per_sec": round(fp32, 1),
         "bf16_samples_per_sec": round(bf16, 1),
-        "bf16_median_of": len(bf16_runs),
-        "bf16_spread_frac": bf16_spread,
+        "bf16_params_samples_per_sec": round(bf16p, 1),
+        "bf16_params_median_of": len(bf16p_runs),
+        "bf16_params_spread_frac": bf16p_spread,
         "bf16_per_dispatch_samples_per_sec": r(bf16_per_dispatch),
         "steps_per_call": {"dlrm": DLRM_STEPS_PER_CALL,
                            "tiny_zoo": ZOO_STEPS_PER_CALL,
@@ -470,7 +482,8 @@ def main():
         "uncapped_bf16_samples_per_sec": r(uncapped_bf16),
         "multihot_ragged_samples_per_sec": r(ragged),
         "multihot_mean_hotness": 15.5,
-        "dense_mfu_bf16_est": round(flops * bf16 / V5E_BF16_PEAK_FLOPS, 4),
+        "dense_mfu_bf16_est": round(
+            flops * max(bf16, bf16p) / V5E_BF16_PEAK_FLOPS, 4),
         "embedding_hbm_gbps_est": round(ebytes * best / 1e9, 1),
         "embedding_hbm_util_est": round(ebytes * best / 1e9 / V5E_HBM_GBPS,
                                         4),

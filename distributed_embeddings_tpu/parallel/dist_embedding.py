@@ -146,8 +146,21 @@ class DistributedEmbedding:
         ``parallel/strategy.py``).
       column_slice_threshold: max elements per slice; larger tables are split
         width-wise into power-of-2 slices.
-      row_slice: reserved (the reference declares-but-does-not-implement row
-        slicing, ``dist_model_parallel.py:225,233-234``).
+      row_slice: max elements per table slice for ROW-wise (vocab-range)
+        slicing — the mode the reference declares but never implements
+        (``dist_model_parallel.py:225,233-234``; its docstring leaves the
+        type "TBD", so an int threshold mirroring
+        ``column_slice_threshold`` is used here). Tables over the threshold
+        split into power-of-2 row-range slices placed like any other table;
+        each slice serves only ids in its range (out-of-range ids read zero
+        rows forward and drop backward) and the slice outputs sum. A table
+        already split by ``column_slice_threshold`` is not row-sliced.
+      masked_reads: if True, out-of-range ids on NON-sliced tables read a
+        ZERO row in the forward instead of clipping into the last row
+        (out-of-range backward always drops). Costs one compare+select per
+        gathered row; makes bad-pipeline ids visible as zeros instead of
+        silently training on the clipped row's values. Row-sliced tables
+        use masked reads regardless (their correctness depends on it).
       dp_input: if True (default) inputs are data-parallel shards
         ``[local_batch, ...]`` per global feature. If False, inputs are
         model-parallel: a :class:`MpInputs` built by :meth:`pack_mp_inputs`
@@ -176,18 +189,26 @@ class DistributedEmbedding:
                  input_table_map: Optional[Sequence[int]] = None,
                  axis_name: str = "data",
                  compute_dtype: Optional[Any] = None,
-                 input_hotness: Optional[Sequence[int]] = None):
-        if row_slice is not None:
-            raise NotImplementedError("Row slicing embedding is not supported yet!")
+                 input_hotness: Optional[Sequence[int]] = None,
+                 masked_reads: bool = False):
+        if row_slice is not None and (isinstance(row_slice, bool)
+                                      or not isinstance(row_slice, int)):
+            # bool subclasses int: row_slice=True would silently mean
+            # threshold 1 (slice EVERY table world-ways)
+            raise TypeError(
+                "row_slice takes an int element threshold (the reference "
+                "left the type 'TBD'; see the class docstring)")
         self.world_size = int(world_size)
         self.axis_name = axis_name
         self.dp_input = dp_input
         self.compute_dtype = compute_dtype
+        self.masked_reads = bool(masked_reads)
         self.strategy = DistEmbeddingStrategy(
             embeddings, self.world_size, strategy=strategy,
             input_table_map=input_table_map,
             column_slice_threshold=column_slice_threshold,
-            input_hotness=input_hotness)
+            input_hotness=input_hotness,
+            row_slice_threshold=row_slice)
         if len(self.strategy.global_configs) < self.world_size:
             raise NotImplementedError(
                 "Fewer tables than mesh positions is not supported "
@@ -743,8 +764,22 @@ class DistributedEmbedding:
                 lax.slice(dp_recv, (inst.rank, 0, c0),
                           (inst.rank + 1, b, c0 + ow)).reshape(b, ow))
         result = [worker_order[i] for i in self.strategy.rev_global_input_ids]
-        for start, end in self.strategy.sliced_out_ranges:
-            result[start:end] = [jnp.concatenate(result[start:end], axis=-1)]
+        # reassemble slices in ascending input order (in-place collapse
+        # invariant, strategy.create_sliced_configs): column slices
+        # concatenate; row slices SUM (out-of-range reads were zeroed)
+        ranges = (
+            [(s, e, "cat") for s, e in self.strategy.sliced_out_ranges]
+            + [(s, e, "sum")
+               for s, e in self.strategy.row_sliced_out_ranges])
+        for start, end, kind in sorted(ranges):
+            if kind == "cat":
+                result[start:end] = [
+                    jnp.concatenate(result[start:end], axis=-1)]
+            else:
+                total = result[start]
+                for part in result[start + 1:end]:
+                    total = total + part
+                result[start:end] = [total]
         return result, ("dist", ids_recv, tuple(encs), b)
 
     # ------------------------------------------------- plan-driven executor
@@ -840,13 +875,15 @@ class DistributedEmbedding:
             full_shape=(plan.l_max,), dtype=comm_dtype, axis=0)
 
     def _ragged_decode(self, g, b: int, region, rows, roff, valid,
-                       need_counts: bool = True):
+                       need_counts: bool = True, rbase=None):
         """Decode one ragged group region ``[world, n*(cap+b)]`` into
         ``(values, lengths, seg, grow, counts)``, all ``[world, n, ...]``.
         Dead slots get zero lengths, so every position routes to the dropped
         segment ``b``. ``valid=None`` means every slot is statically live
         (skips the mask multiply); ``need_counts=False`` skips the
-        mean-divisor counts (sum-only groups never read them)."""
+        mean-divisor counts (sum-only groups never read them); ``rbase``
+        (row-sliced slots) is subtracted from the raw values before the
+        clip — ``values`` stays raw so callers mask consistently."""
         world = self.world_size
         r3 = region.reshape(world, g.n, g.blen)
         values = r3[:, :, :g.hot]
@@ -854,7 +891,9 @@ class DistributedEmbedding:
         if valid is not None:
             lengths = lengths * valid[None, :, None].astype(r3.dtype)
         _, seg = self._csr_seg(lengths, g.hot)
-        grow = (jnp.clip(values, 0, (rows - 1)[None, :, None])
+        loc = (values - rbase[None, :, None] if rbase is not None
+               else values)
+        grow = (jnp.clip(loc, 0, (rows - 1)[None, :, None])
                 + roff[None, :, None])
         counts = jnp.maximum(lengths, 1) if need_counts else None
         return values, lengths, seg, grow, counts
@@ -888,13 +927,31 @@ class DistributedEmbedding:
             any_mean = bool(plan.mean[gi].any())
             all_mean = bool(plan.mean[gi].all())
             all_valid = bool((plan.valid[gi] > 0).all())
+            # row-sliced slots subtract their range base and must read zero
+            # outside the range (their outputs SUM across slices); the same
+            # mask doubles as the opt-in masked_reads debug contract. The
+            # mask is gated PER SLOT (plan.rsliced): an unsliced table that
+            # shares the exchange group keeps the documented
+            # clip-to-last-row read unless masked_reads=True.
+            any_rslice = bool(plan.rsliced[gi].any())
+            use_mask = any_rslice or self.masked_reads
+            rbase = (self._plan_row(plan.rbase[gi], my) if any_rslice
+                     else None)
             region = lax.slice(ids_recv, (0, g.goff),
                                (world, g.goff + g.n * g.blen))
             if g.kind == "d":
                 ids = region.reshape(world, g.n, b, g.hot)
+                if rbase is not None:
+                    ids = ids - rbase[None, :, None, None]
                 grow = (jnp.clip(ids, 0, (rows - 1)[None, :, None, None])
                         + roff[None, :, None, None])
                 gath = ps.packed_gather(slab, grow, g.width)
+                if use_mask:
+                    inr = ((ids >= 0) & (ids < rows[None, :, None, None]))
+                    if not self.masked_reads:  # only sliced slots mask
+                        rsl = self._plan_row(plan.rsliced[gi], my)
+                        inr = inr | (rsl[None, :, None, None] == 0)
+                    gath = gath * inr[..., None].astype(gath.dtype)
                 red = jnp.sum(gath, axis=3)  # [world, n, b, w]
                 if g.hot > 1 and any_mean:
                     if all_mean:
@@ -904,11 +961,19 @@ class DistributedEmbedding:
                         red = jnp.where(mean[None, :, None, None] > 0,
                                         red / g.hot, red)
             else:
-                _, _, seg, grow, counts = self._ragged_decode(
+                values, _, seg, grow, counts = self._ragged_decode(
                     g, b, region, rows, roff,
                     None if all_valid else self._plan_row(plan.valid[gi], my),
-                    need_counts=any_mean)
+                    need_counts=any_mean, rbase=rbase)
                 gath = ps.packed_gather(slab, grow, g.width)  # [w, n, cap, ww]
+                if use_mask:
+                    loc = (values - rbase[None, :, None]
+                           if rbase is not None else values)
+                    inr = ((loc >= 0) & (loc < rows[None, :, None]))
+                    if not self.masked_reads:  # only sliced slots mask
+                        rsl = self._plan_row(plan.rsliced[gi], my)
+                        inr = inr | (rsl[None, :, None] == 0)
+                    gath = gath * inr[..., None].astype(gath.dtype)
                 sidx = self._ragged_scatter_idx(g, b, world, seg)
                 buf = jnp.zeros((world * g.n * (b + 1), g.width), gath.dtype)
                 # sidx ascends globally: (source, slot) blocks are laid out
@@ -1020,9 +1085,14 @@ class DistributedEmbedding:
         expanded: List[Optional[jax.Array]] = []
         e = 0
         for i, g in enumerate(out_grads):
-            k = self._slices_per_table[self.strategy.input_table_map[i]]
+            tid = self.strategy.input_table_map[i]
+            k = self._slices_per_table[tid]
             if k == 1:
                 expanded.append(g)
+            elif tid in self.strategy.row_sliced_tables:
+                # output was the SUM of row slices, so every slice's
+                # cotangent is the full g (its own out-of-range rows drop)
+                expanded.extend([g] * k)
             else:
                 pos = 0
                 for s in range(k):
@@ -1063,6 +1133,8 @@ class DistributedEmbedding:
             all_valid = bool((plan.valid[gi] > 0).all())
             valid = (None if all_valid
                      else self._plan_row(plan.valid[gi], my))
+            rbase = (self._plan_row(plan.rbase[gi], my)
+                     if plan.rsliced[gi].any() else None)
             sent = self.rows_cap[g.width]  # dropped-row sentinel (logical)
             region = lax.slice(ids_recv, (0, g.goff),
                                (world, g.goff + g.n * g.blen))
@@ -1079,6 +1151,8 @@ class DistributedEmbedding:
                 # stream order is free to choose (docs/perf_tpu.md r4).
                 ids4 = region.reshape(world, g.n, b, g.hot
                                       ).transpose(0, 2, 1, 3)
+                if rbase is not None:  # row-sliced slots: range-local ids
+                    ids4 = ids4 - rbase[None, None, :, None]
                 # out-of-range ids were clipped in the forward (safety net)
                 # but are dropped here: a bad id trains nothing (see module
                 # docstring contract)
@@ -1102,7 +1176,9 @@ class DistributedEmbedding:
                 # (source, slot, row): one small copy, the take absorbs it
                 values, _, seg, _, counts = self._ragged_decode(
                     g, b, region, rows, roff, valid,
-                    need_counts=any_mean)
+                    need_counts=any_mean, rbase=rbase)
+                if rbase is not None:  # row-sliced slots: range-local ids
+                    values = values - rbase[None, :, None]
                 sidx = self._ragged_scatter_idx(g, b, world, seg)
                 gpad = jnp.concatenate(
                     [gsl, self._vary(jnp.zeros((world, g.n, 1, g.width),
@@ -1136,12 +1212,14 @@ class DistributedEmbedding:
     # ------------------------------------------------------------- checkpoint
 
     def _slice_plan(self):
-        """Per-(rank, local table) checkpoint routing:
-        ``plan[rank][m] = (table_id, row_offset, rows, col_start, width)``
-        where ``col_start`` is the slice's first column in the full (unsliced)
-        source table — column slices are consumed in rank order, the
-        reference's ``_slice_weight_for_rank`` math
-        (``dist_model_parallel.py:346-361``)."""
+        """Per-(rank, local table) checkpoint routing: ``plan[rank][m] =
+        (table_id, slab_row_offset, rows, col_start, width, row_base)``
+        where ``col_start`` is the slice's first column in the full
+        (unsliced) source table — column slices are consumed in rank order,
+        the reference's ``_slice_weight_for_rank`` math
+        (``dist_model_parallel.py:346-361``) — and ``row_base`` is the
+        slice's first global row (0 except for row slices, whose columns
+        always span the full width)."""
         col_pos = {tid: 0 for tid in range(len(self.strategy.global_configs))}
         plan: List[List[tuple]] = []
         for r, cfgs in enumerate(self.strategy.local_configs_list):
@@ -1149,8 +1227,12 @@ class DistributedEmbedding:
             for m, cfg in enumerate(cfgs):
                 _, roff, rows, w = self._table_rows(r, m)
                 tid = self.strategy.table_ids_list[r][m]
-                rank_plan.append((tid, roff, rows, col_pos[tid], w))
-                col_pos[tid] += w
+                if tid in self.strategy.row_sliced_tables:
+                    rank_plan.append(
+                        (tid, roff, rows, 0, w, int(cfg["_row_base"])))
+                else:
+                    rank_plan.append((tid, roff, rows, col_pos[tid], w, 0))
+                    col_pos[tid] += w
             plan.append(rank_plan)
         return plan
 
@@ -1240,12 +1322,13 @@ class DistributedEmbedding:
         out: List[Optional[np.ndarray]] = (
             [None] * len(self.strategy.global_configs))
         for r, rank_plan in enumerate(self._slice_plan()):
-            for tid, roff, rows, c0, w in rank_plan:
+            for tid, roff, rows, c0, w, rb in rank_plan:
                 v = params[_wkey(w)]
                 if keep and out[tid] is None:
-                    full_w = int(
-                        self.strategy.global_configs[tid]["output_dim"])
-                    out[tid] = np.empty((rows, full_w), v.dtype)
+                    cfg = self.strategy.global_configs[tid]
+                    out[tid] = np.empty(
+                        (int(cfg["input_dim"]), int(cfg["output_dim"])),
+                        v.dtype)
                 p = ps.pack_factor(w)
                 chunk_rows = max(p, (int(chunk_elems) // max(w, 1)) // p * p)
                 for s in range(0, rows, chunk_rows):
@@ -1253,8 +1336,8 @@ class DistributedEmbedding:
                     phys = self._fetch_rows(
                         v, r, (roff + s) // p, -(-n // p), to_host=keep)
                     if keep:
-                        out[tid][s:s + n, c0:c0 + w] = ps.unpack_rows_np(
-                            phys, w)[:n]
+                        out[tid][rb + s:rb + s + n, c0:c0 + w] = \
+                            ps.unpack_rows_np(phys, w)[:n]
         return out if keep else None
 
     def _build_shard(self, loaded, dev, width: int, r0: int, r1: int,
@@ -1277,17 +1360,22 @@ class DistributedEmbedding:
         chunk_rows = max(p, (int(chunk_elems) // max(width, 1)) // p * p)
         for r in range(r0, r1):
             base = (r - r0) * self.phys_cap[width]
-            for tid, roff, rows, c0, w in plan[r]:
+            for tid, roff, rows, c0, w, rb in plan[r]:
                 if w != width:
                     continue
                 src = loaded[tid]
-                if src.shape[0] != rows:
+                # exact-size check (a looser bound would let an oversized
+                # source load silently truncated): row slices must tile the
+                # declared global vocab, plain tables must equal it
+                full = int(self.strategy.global_configs[tid]["input_dim"])
+                if src.shape[0] != full:
                     raise ValueError(
-                        f"Table {tid}: expected {rows} rows, got {src.shape[0]}")
+                        f"Table {tid}: expected {full} rows, got "
+                        f"{src.shape[0]}")
                 for s in range(0, rows, chunk_rows):
                     n = min(chunk_rows, rows - s)
                     host = np.ascontiguousarray(
-                        src[s:s + n, c0:c0 + w], dtype=dtype)
+                        src[rb + s:rb + s + n, c0:c0 + w], dtype=dtype)
                     if n % p:  # pad into the table's alignment padding
                         host = np.concatenate(
                             [host, np.zeros((p - n % p, w), host.dtype)])

@@ -86,7 +86,14 @@ class ExchangePlan:
     * ``valid`` — 1.0 for live slots, 0.0 for padding (backward routes dead
       slots' ids to the dropped sentinel);
     * ``mean``  — 1.0 where the slot's combiner is ``'mean'`` (forward
-      divides the reduced sum, backward divides the cotangent).
+      divides the reduced sum, backward divides the cotangent);
+    * ``rbase`` — slot's first global row for row-sliced tables (subtracted
+      from incoming ids; out-of-slice ids read zero forward and drop
+      backward). 0 everywhere else;
+    * ``rsliced`` — 1.0 exactly for row-sliced slots (``rbase`` can't mark
+      them: a table's FIRST row slice has base 0). Gates the forward
+      zero-read mask per slot so unsliced tables sharing the group keep the
+      documented clip-to-last-row read.
     """
 
     b: int
@@ -98,6 +105,8 @@ class ExchangePlan:
     roff: Tuple[np.ndarray, ...]
     valid: Tuple[np.ndarray, ...]
     mean: Tuple[np.ndarray, ...]
+    rbase: Tuple[np.ndarray, ...]
+    rsliced: Tuple[np.ndarray, ...]
 
     def out_width(self, inst: InstanceSpec) -> int:
         return self.groups[inst.group].width * inst.num_slots
@@ -127,14 +136,18 @@ def build_plan(strategy, row_offsets_list: Sequence[Sequence[int]],
             rows = int(cfg["input_dim"])
             roff = int(row_offsets_list[r][m])
             comb = cfg.get("combiner")
+            rbase = int(cfg.get("_row_base", 0))
+            rsl = 1.0 if "_row_base" in cfg else 0.0
             kind, param = encs[i]
             if kind == "d":
                 if comb:
                     key = ("d", w, int(param))
-                    entries = [(rows, roff, 1.0, 1.0 if comb == "mean" else 0.0)]
+                    entries = [(rows, roff, 1.0,
+                                1.0 if comb == "mean" else 0.0, rbase, rsl)]
                 else:
                     key = ("d", w, 1)
-                    entries = [(rows, roff, 1.0, 0.0)] * int(param)
+                    entries = [(rows, roff, 1.0, 0.0, rbase, rsl)
+                               ] * int(param)
             else:
                 if comb is None:
                     # without this, a combiner-less table would silently get
@@ -144,7 +157,8 @@ def build_plan(strategy, row_offsets_list: Sequence[Sequence[int]],
                         f"{strategy.input_table_map[i]} has no combiner; "
                         "ragged features require combiner='sum' or 'mean'")
                 key = ("r", w, int(param))
-                entries = [(rows, roff, 1.0, 1.0 if comb == "mean" else 0.0)]
+                entries = [(rows, roff, 1.0,
+                            1.0 if comb == "mean" else 0.0, rbase, rsl)]
             slots = key_slots.setdefault(key, [[] for _ in range(world)])
             inst_raw.append((i, r, key, len(slots[r]), len(entries)))
             slots[r].extend(entries)
@@ -152,7 +166,8 @@ def build_plan(strategy, row_offsets_list: Sequence[Sequence[int]],
     # pass 2: deterministic group order, cumulative offsets, plan tensors
     keys = sorted(key_slots)
     gidx = {k: g for g, k in enumerate(keys)}
-    groups, rows_l, roff_l, valid_l, mean_l = [], [], [], [], []
+    groups = []
+    rows_l, roff_l, valid_l, mean_l, rbase_l, rsl_l = [], [], [], [], [], []
     goff = col = 0
     for k in keys:
         slots = key_slots[k]
@@ -166,14 +181,19 @@ def build_plan(strategy, row_offsets_list: Sequence[Sequence[int]],
         roff_a = np.zeros((world, n), np.int32)
         val_a = np.zeros((world, n), np.float32)
         mn_a = np.zeros((world, n), np.float32)
+        rb_a = np.zeros((world, n), np.int32)
+        rs_a = np.zeros((world, n), np.float32)
         for r in range(world):
-            for kk, (tr, to, tv, tm) in enumerate(slots[r]):
+            for kk, (tr, to, tv, tm, trb, trs) in enumerate(slots[r]):
                 rows_a[r, kk], roff_a[r, kk] = tr, to
                 val_a[r, kk], mn_a[r, kk] = tv, tm
+                rb_a[r, kk], rs_a[r, kk] = trb, trs
         rows_l.append(rows_a)
         roff_l.append(roff_a)
         valid_l.append(val_a)
         mean_l.append(mn_a)
+        rbase_l.append(rb_a)
+        rsl_l.append(rs_a)
 
     instances = tuple(
         InstanceSpec(i, r, gidx[k], s0, ns) for i, r, k, s0, ns in inst_raw)
@@ -181,4 +201,5 @@ def build_plan(strategy, row_offsets_list: Sequence[Sequence[int]],
         b=b, groups=tuple(groups), instances=instances,
         l_max=max(goff, 1), s_max=max(col, 1),
         rows=tuple(rows_l), roff=tuple(roff_l),
-        valid=tuple(valid_l), mean=tuple(mean_l))
+        valid=tuple(valid_l), mean=tuple(mean_l), rbase=tuple(rbase_l),
+        rsliced=tuple(rsl_l))

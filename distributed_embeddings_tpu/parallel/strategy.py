@@ -58,6 +58,42 @@ def maybe_slice_table_column(orig_config: Config,
     return slices
 
 
+def maybe_slice_table_row(orig_config: Config,
+                          row_slice_threshold: Optional[int],
+                          world_size: int) -> List[Config]:
+    """Split a table row-wise (vocab ranges) into the smallest power-of-2
+    number of slices that brings each slice under ``row_slice_threshold``
+    elements, capped at ``min(world_size, input_dim)``; row remainder spread
+    over the first slices. Each slice carries its first global row in
+    ``_row_base`` (consumed by the exchange plan and checkpoint paths).
+
+    The reference declares-but-never-implements this mode
+    (``dist_model_parallel.py:225,233-234``); semantics here mirror
+    :func:`maybe_slice_table_column` with rows in place of columns. Unlike
+    column slices (every slice serves every id, outputs concatenate), a row
+    slice serves only ids inside its range — out-of-range ids read as zero
+    rows — and slice outputs SUM.
+    """
+    if row_slice_threshold is None:
+        return [dict(orig_config)]
+    elements = _table_elements(orig_config)
+    num_slices = 1
+    while elements > row_slice_threshold * num_slices:
+        num_slices *= 2
+    if num_slices == 1:
+        return [dict(orig_config)]
+    num_slices = min(num_slices, world_size, int(orig_config["input_dim"]))
+    base, rem = divmod(int(orig_config["input_dim"]), num_slices)
+    slices, row_base = [], 0
+    for i in range(num_slices):
+        cfg = dict(orig_config)
+        cfg["input_dim"] = base + (1 if i < rem else 0)
+        cfg["_row_base"] = row_base
+        row_base += cfg["input_dim"]
+        slices.append(cfg)
+    return slices
+
+
 def apply_strategy(mode: str, world_size: int,
                    sliced_configs: List[List[Config]],
                    input_table_map: Optional[Sequence[int]] = None,
@@ -183,12 +219,14 @@ class DistEmbeddingStrategy:
                  strategy: str = "basic",
                  input_table_map: Optional[Sequence[int]] = None,
                  column_slice_threshold: Optional[int] = None,
-                 input_hotness: Optional[Sequence[int]] = None):
+                 input_hotness: Optional[Sequence[int]] = None,
+                 row_slice_threshold: Optional[int] = None):
         if strategy not in _STRATEGIES:
             raise ValueError(f"Unsupported shard strategy {strategy}")
         self.strategy = strategy
         self.world_size = world_size
         self.column_slice_threshold = column_slice_threshold
+        self.row_slice_threshold = row_slice_threshold
         self.global_configs = [
             c.get_config() if hasattr(c, "get_config") else dict(c)
             for c in configs]
@@ -216,10 +254,15 @@ class DistEmbeddingStrategy:
                 for t in self.input_table_map]
             self.rev_global_input_ids = list(range(len(self.input_table_map)))
             self.sliced_out_ranges = []
+            self.row_sliced_out_ranges = []
+            self.row_sliced_tables = set()
             return
 
-        sliced_configs, self.sliced_out_ranges = self.create_sliced_configs(
-            world_size, column_slice_threshold, self.input_table_map)
+        (sliced_configs, self.sliced_out_ranges,
+         self.row_sliced_out_ranges, self.row_sliced_tables) = \
+            self.create_sliced_configs(
+                world_size, column_slice_threshold, self.input_table_map,
+                row_slice_threshold)
         self.table_ids_list = apply_strategy(strategy, world_size,
                                              sliced_configs,
                                              self.input_table_map,
@@ -256,25 +299,47 @@ class DistEmbeddingStrategy:
 
     def create_sliced_configs(self, world_size: int,
                               column_slice_threshold: Optional[int],
-                              input_table_map: Sequence[int]):
-        """Column-slice each oversized table and record, in *input order*, the
-        output ranges that must be concatenated back (reference
-        ``dist_model_parallel.py:133-157``).
+                              input_table_map: Sequence[int],
+                              row_slice_threshold: Optional[int] = None):
+        """Slice each oversized table and record, in *input order*, the
+        output ranges to reassemble: column slices concatenate (reference
+        ``dist_model_parallel.py:133-157``), row slices sum.
+
+        Column slicing takes precedence; a table it split is not row-sliced
+        (the two thresholds express the same capacity constraint, and a
+        doubly-sliced table would need a 2-D slice grid the exchange layout
+        has no use for).
 
         Range bookkeeping invariant: ranges are expressed as
         ``[input_id, input_id + num_slices]`` and consumed in increasing input
         order with in-place collapse — after collapsing all earlier ranges each
-        input's expanded output block starts exactly at its input id.
+        input's expanded output block starts exactly at its input id. The
+        forward must therefore process column and row ranges together in
+        ascending input order.
         """
-        sliced_configs = [
-            maybe_slice_table_column(cfg, column_slice_threshold, world_size)
-            for cfg in self.global_configs]
+        sliced_configs = []
+        row_sliced_tables = set()
+        for tid, cfg in enumerate(self.global_configs):
+            col = maybe_slice_table_column(cfg, column_slice_threshold,
+                                           world_size)
+            if len(col) > 1:
+                sliced_configs.append(col)
+                continue
+            row = maybe_slice_table_row(cfg, row_slice_threshold, world_size)
+            if len(row) > 1:
+                row_sliced_tables.add(tid)
+            sliced_configs.append(row)
         sliced_out_ranges = []
+        row_sliced_out_ranges = []
         for input_id, table_id in enumerate(input_table_map):
             if len(sliced_configs[table_id]) > 1:
-                sliced_out_ranges.append(
-                    [input_id, input_id + len(sliced_configs[table_id])])
-        return sliced_configs, sliced_out_ranges
+                rng = [input_id, input_id + len(sliced_configs[table_id])]
+                if table_id in row_sliced_tables:
+                    row_sliced_out_ranges.append(rng)
+                else:
+                    sliced_out_ranges.append(rng)
+        return (sliced_configs, sliced_out_ranges, row_sliced_out_ranges,
+                row_sliced_tables)
 
     # ----- derived views used by the executor -----
 

@@ -1,0 +1,220 @@
+"""Row slicing: the mode the reference declares but never implements
+(``dist_model_parallel.py:225,233-234``) — implemented here (VERDICT r3
+stretch). A row-sliced table's vocab splits into ranges placed like
+independent tables; each slice serves only in-range ids (zero rows outside)
+and slice outputs sum.
+
+Tests: forward oracle parity (dense 1-hot / multi-hot sum+mean / ragged over
+a row-sliced table), full-train-step parity sliced vs UNsliced from identical
+weights, checkpoint roundtrip through the row-range slice plan, and the
+masked_reads debug contract."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_embeddings_tpu.ops.embedding_lookup import Ragged
+from distributed_embeddings_tpu.parallel import (
+    DistributedEmbedding, SparseSGD, make_hybrid_train_step, HybridTrainState)
+from distributed_embeddings_tpu.parallel.strategy import maybe_slice_table_row
+
+WORLD = 8
+B = 16  # global batch
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return Mesh(np.array(jax.devices()[:WORLD]), ("data",))
+
+
+def _configs():
+    # table 0 is big -> row-sliced into 4 ranges of 25 rows; the rest plain
+    return [
+        {"input_dim": 100, "output_dim": 8, "combiner": None},
+        {"input_dim": 30, "output_dim": 8, "combiner": "sum"},
+        {"input_dim": 100, "output_dim": 8, "combiner": "mean"},
+        {"input_dim": 40, "output_dim": 8, "combiner": None},
+        {"input_dim": 26, "output_dim": 8, "combiner": "sum"},
+        {"input_dim": 100, "output_dim": 4, "combiner": "sum"},
+        {"input_dim": 22, "output_dim": 8, "combiner": None},
+        {"input_dim": 24, "output_dim": 8, "combiner": None},
+    ]
+
+
+ROW_THR = 100 * 8 // 4 + 1  # tables with 100 rows split into 4 row slices
+
+
+def _tables(rng, configs):
+    return [rng.normal(size=(c["input_dim"], c["output_dim"])
+                       ).astype(np.float32) for c in configs]
+
+
+def _make_inputs(rng, configs):
+    cats, oracle = [], []
+    for cfg in configs:
+        if cfg["combiner"] is None:
+            ids = rng.integers(0, cfg["input_dim"], size=(B,))
+            cats.append(jnp.asarray(ids, jnp.int32))
+            oracle.append(("d1", ids))
+        elif cfg["input_dim"] == 100 and cfg["combiner"] == "mean":
+            # ragged over the row-sliced mean table
+            lens = rng.integers(0, 4, size=B)
+            splits = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
+            cap = 4 * (B // WORLD) * WORLD
+            vals = np.zeros(cap, np.int32)
+            vals[:splits[-1]] = rng.integers(0, 100, size=int(splits[-1]))
+            # flat per-shard CSR blocks (shard s: rows s*cap/W, splits local)
+            per = B // WORLD
+            v_parts, s_parts = [], []
+            for s in range(WORLD):
+                lo, hi = splits[s * per], splits[(s + 1) * per]
+                seg = np.zeros(cap // WORLD, np.int32)
+                seg[:hi - lo] = vals[lo:hi]
+                v_parts.append(seg)
+                s_parts.append((splits[s * per:(s + 1) * per + 1]
+                                - lo).astype(np.int32))
+            cats.append(Ragged(values=jnp.asarray(np.concatenate(v_parts)),
+                               row_splits=jnp.asarray(
+                                   np.concatenate(s_parts))))
+            oracle.append(("r", (vals, splits)))
+        else:
+            hot = 3
+            ids = rng.integers(0, cfg["input_dim"], size=(B, hot))
+            cats.append(jnp.asarray(ids, jnp.int32))
+            oracle.append(("dh", ids))
+    return cats, oracle
+
+
+def _oracle_outs(tables, configs, oracle):
+    outs = []
+    for cfg, tab, (kind, data) in zip(configs, tables, oracle):
+        if kind == "d1":
+            outs.append(tab[data])
+        elif kind == "dh":
+            red = tab[data].sum(axis=1)
+            if cfg["combiner"] == "mean":
+                red = red / data.shape[1]
+            outs.append(red)
+        else:
+            vals, splits = data
+            o = np.zeros((B, tab.shape[1]), np.float32)
+            for i in range(B):
+                seg = vals[splits[i]:splits[i + 1]]
+                if len(seg):
+                    o[i] = tab[seg].sum(0) / (
+                        len(seg) if cfg["combiner"] == "mean" else 1)
+            outs.append(o)
+    return outs
+
+
+def _dist_forward(de, params, cats, mesh):
+    n = len(cats)
+
+    def f(p, *cs):
+        return [o.astype(jnp.float32) for o in de(p, list(cs))]
+
+    sm = jax.shard_map(
+        f, mesh=mesh, in_specs=(P("data"),) + (P("data"),) * n,
+        out_specs=P("data"))
+    return sm(params, *cats)
+
+
+def test_maybe_slice_table_row_geometry():
+    cfg = {"input_dim": 103, "output_dim": 8}
+    slices = maybe_slice_table_row(cfg, 103 * 8 // 4 + 1, 8)
+    assert len(slices) == 4
+    assert [s["input_dim"] for s in slices] == [26, 26, 26, 25]
+    assert [s["_row_base"] for s in slices] == [0, 26, 52, 78]
+    assert maybe_slice_table_row(cfg, None, 8) == [dict(cfg)]
+
+
+def test_row_sliced_forward_matches_oracle(mesh):
+    rng = np.random.default_rng(0)
+    configs = _configs()
+    de = DistributedEmbedding(configs, world_size=WORLD,
+                              strategy="memory_balanced", row_slice=ROW_THR)
+    assert de.strategy.row_sliced_tables  # the big tables actually split
+    tables = _tables(rng, configs)
+    params = de.set_weights(tables, mesh=mesh)
+    cats, oracle = _make_inputs(rng, configs)
+    outs = _dist_forward(de, params, cats, mesh)
+    want = _oracle_outs(tables, configs, oracle)
+    for t, (o, w_) in enumerate(zip(outs, want)):
+        np.testing.assert_allclose(np.asarray(o), w_, rtol=1e-5, atol=1e-5,
+                                   err_msg=f"table {t}")
+
+
+def test_row_sliced_train_step_matches_unsliced(mesh):
+    rng = np.random.default_rng(1)
+    configs = _configs()
+    tables = _tables(rng, configs)
+    cats, _ = _make_inputs(rng, configs)
+    y = jnp.asarray(rng.normal(size=(B, 1)) * 0.1, jnp.float32)
+    cols = sum(c["output_dim"] for c in configs)
+    wvec = jnp.asarray(rng.normal(size=(cols, 1)) * 0.3, jnp.float32)
+
+    def run(row_slice):
+        de = DistributedEmbedding(configs, world_size=WORLD,
+                                  strategy="memory_balanced",
+                                  row_slice=row_slice)
+        params = de.set_weights(tables, mesh=mesh)
+        emb_opt = SparseSGD()
+        tx = optax.sgd(0.5)
+        dp = {"w": jnp.array(wvec)}
+
+        def loss_fn(dpar, outs, batch):
+            x = jnp.concatenate(
+                [o.reshape(o.shape[0], -1) for o in outs], axis=1)
+            return jnp.mean((x @ dpar["w"] - batch) ** 2)
+
+        state = HybridTrainState(
+            emb_params=params, emb_opt_state=emb_opt.init(params),
+            dense_params=dp, dense_opt_state=tx.init(dp),
+            step=jnp.zeros((), jnp.int32))
+        step = make_hybrid_train_step(de, loss_fn, tx, emb_opt, mesh=mesh,
+                                      lr_schedule=0.3)
+        y_sh = jax.device_put(y, NamedSharding(mesh, P("data")))
+        loss, state = step(state, cats, y_sh)
+        return float(loss), de.get_weights(state.emb_params)
+
+    loss_a, tabs_a = run(None)
+    loss_b, tabs_b = run(ROW_THR)
+    assert abs(loss_a - loss_b) < 1e-5
+    for t, (ta, tb) in enumerate(zip(tabs_a, tabs_b)):
+        np.testing.assert_allclose(ta, tb, rtol=1e-5, atol=1e-6,
+                                   err_msg=f"table {t}")
+
+
+def test_row_sliced_checkpoint_roundtrip(mesh):
+    rng = np.random.default_rng(2)
+    configs = _configs()
+    de = DistributedEmbedding(configs, world_size=WORLD,
+                              strategy="basic", row_slice=ROW_THR)
+    tables = _tables(rng, configs)
+    params = de.set_weights(tables, mesh=mesh)
+    back = de.get_weights(params)
+    for t, (a, b) in enumerate(zip(tables, back)):
+        np.testing.assert_array_equal(a, b, err_msg=f"table {t}")
+
+
+def test_masked_reads_zero_out_of_range(mesh):
+    configs = [{"input_dim": 16 + i, "output_dim": 4, "combiner": None}
+               for i in range(WORLD)]
+    rng = np.random.default_rng(3)
+    tables = _tables(rng, configs)
+    ids = [jnp.asarray(rng.integers(0, c["input_dim"], size=(B,)), jnp.int32)
+           for c in configs]
+    bad = np.asarray(ids[0]).copy()
+    bad[::3] = 10_000  # way out of range
+    ids[0] = jnp.asarray(bad)
+
+    de = DistributedEmbedding(configs, world_size=WORLD, masked_reads=True)
+    params = de.set_weights(tables, mesh=mesh)
+    outs = _dist_forward(de, params, ids, mesh)
+    out0 = np.asarray(outs[0])
+    assert np.all(out0[::3] == 0.0)  # bad ids read zero rows
+    good = np.asarray(ids[0])[1::3]
+    np.testing.assert_allclose(out0[1::3], tables[0][good], rtol=1e-6)
