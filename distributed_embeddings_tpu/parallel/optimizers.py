@@ -51,23 +51,29 @@ from ..ops.packed_slab import expand_lane_mask, pack_factor
 from ..ops.sparse_grad import dedup_sparse_grad
 
 
-# Explicit-sort scatter pays off only below this stream length. Measured on
-# v5e (docs/perf_tpu.md round-4 table): at 1.7M rows into a 5.4 GB slab the
-# unsorted scatter runs 38.5 ms where sort+fused-permute+sorted-scatter runs
-# ~18 (the isolated pre-sorted scatter is 5.9) — but at 2.9M (tiny zoo) and
-# 6.6M (DCNv2 ragged) rows the explicit sort+permute chain costs MORE than
-# XLA's internal unsorted lowering (+31 / +16 ms end-to-end).
+# The explicit-sort scatter wins only in a WINDOW of stream lengths —
+# XLA's TPU scatter lowering changes algorithm with stream length, slab
+# size and dtype, and measurement (docs/perf_tpu.md round-4 table) beats
+# modeling here:
+#   * 1.7M rows:  sorted wins big (5.4 GB fp32: 38.5 -> ~18 ms;
+#     10.2 GB bf16: 139 -> 73 ms);
+#   * >= 2.9M rows (tiny zoo, DCNv2 ragged): the sort+permute chain costs
+#     MORE than the internal lowering (+31 / +16 ms end-to-end);
+#   * small streams into huge slabs (65k rows / 10.2 GB bf16, the
+#     Criteo-1TB shard): sorted is 3x WORSE (54 vs 19 ms) — the unsorted
+#     lowering is slab-copy-bound there and the sorted one is worse still.
+_SORT_STREAM_MIN = 256_000
 _SORT_STREAM_MAX = 2_000_000
 
 
 def _sorted_scatter_add(slab: jax.Array, ids: jax.Array,
                         vals: jax.Array) -> jax.Array:
     """``slab.at[ids].add(vals)``, sorting the id keys first when the stream
-    is short enough for the explicit sort to win (see ``_SORT_STREAM_MAX``):
-    keys sort at 3.4 ns/key, the value permute rides the scatter as a fused
-    gather operand, and the scatter declares sortedness."""
+    length falls in the measured win window (see above): keys sort at
+    3.4 ns/key, the value permute rides the scatter as a fused gather
+    operand, and the scatter declares sortedness."""
     n = ids.shape[0]
-    if n > _SORT_STREAM_MAX:
+    if not (_SORT_STREAM_MIN <= n <= _SORT_STREAM_MAX):
         return slab.at[ids].add(vals, mode="drop")
     sorted_ids, perm = lax.sort_key_val(
         ids, jnp.arange(n, dtype=jnp.int32))
