@@ -63,16 +63,24 @@ def mp_forward(de, mesh, flat, mp_in):
         out_specs=P("data")))(flat, mp_in)
 
 
-@pytest.mark.parametrize("strategy,column_slice_threshold",
-                         [("basic", None), ("memory_balanced", None),
-                          ("memory_balanced", 150)])
+@pytest.mark.parametrize("strategy,column_slice_threshold,row_slice",
+                         [("basic", None, None),
+                          ("memory_balanced", None, None),
+                          ("memory_balanced", 150, None),
+                          # row slicing through the mp-input path, and both
+                          # slicing modes at once under comm_balanced
+                          ("memory_balanced", None, 200),
+                          ("comm_balanced", 300, 150)])
 def test_mp_ragged_forward_matches_oracle(mesh, strategy,
-                                          column_slice_threshold):
+                                          column_slice_threshold, row_slice):
     rng = np.random.default_rng(41)
     configs, kinds = ragged_model(rng)
     de = DistributedEmbedding(configs, world_size=WORLD, strategy=strategy,
                               dp_input=False,
-                              column_slice_threshold=column_slice_threshold)
+                              column_slice_threshold=column_slice_threshold,
+                              row_slice=row_slice)
+    if row_slice is not None:
+        assert de.strategy.row_sliced_tables  # the mode actually engages
     flat = de.init(jax.random.key(0), mesh=mesh)
     tables = de.get_weights(flat)
     dist_inputs, shard_rows = make_mixed_inputs(rng, configs, kinds)
