@@ -217,7 +217,8 @@ def run_dlrm(table_sizes, compute_dtype, param_dtype=jnp.float32,
     return batch * K / dt
 
 
-def run_tiny_zoo(opt_name, steps_per_call=ZOO_STEPS_PER_CALL):
+def run_tiny_zoo(opt_name, steps_per_call=ZOO_STEPS_PER_CALL,
+                 param_dtype=jnp.float32):
     """Synthetic `tiny` zoo model (55 tables, 4.3 GB uncapped, batch 65536)
     — BASELINE.md's main table; the reference's 1xA100 Adagrad number is
     24.433 ms/iter (`synthetic_models/README.md:69`). Multi-step scanned
@@ -251,7 +252,7 @@ def run_tiny_zoo(opt_name, steps_per_call=ZOO_STEPS_PER_CALL):
         return jnp.mean((dense.apply(dp, n, emb_outs) - y) ** 2)
 
     state = init_hybrid_state(de, emb_opt, dense_params, tx,
-                              jax.random.key(1))
+                              jax.random.key(1), dtype=param_dtype)
     loop_fn = make_hybrid_train_loop(de, loss_fn, tx, emb_opt,
                                      lr_schedule=0.01)
     dt = timed_loop(loop_fn, state,
@@ -454,6 +455,11 @@ def main():
     tiny_adagrad_ms = _guard("tiny_adagrad",
                              lambda: run_tiny_zoo("adagrad"))
     tiny_sgd_ms = _guard("tiny_sgd", lambda: run_tiny_zoo("sgd"))
+    # bf16 tables (the reference's own headline precision is reduced too:
+    # TF32 / AMP): halves every slab-wide pass of the dense-apply regime
+    tiny_adagrad_bf16_ms = _guard(
+        "tiny_adagrad_bf16",
+        lambda: run_tiny_zoo("adagrad", param_dtype=jnp.bfloat16))
     best = max(fp32, bf16, bf16p)
 
     flops = dense_flops_per_sample(cfg_probe, len(capped))
@@ -489,6 +495,7 @@ def main():
                                         4),
         "tiny_zoo_adagrad_ms_per_iter": r(tiny_adagrad_ms),
         "tiny_zoo_sgd_ms_per_iter": r(tiny_sgd_ms),
+        "tiny_zoo_adagrad_bf16_ms_per_iter": r(tiny_adagrad_bf16_ms),
         "tiny_zoo_vs_a100_1gpu": (
             None if tiny_adagrad_ms is None
             else round(24.433 / tiny_adagrad_ms, 3)),
