@@ -1314,19 +1314,31 @@ class DistributedEmbedding:
             this keeps the full-model host footprint confined to the
             checkpoint-writing process.
         """
+        keep = all_ranks or jax.process_index() == 0
+        out = [self.get_table(params, tid, chunk_elems=chunk_elems,
+                              all_ranks=all_ranks)
+               for tid in range(len(self.strategy.global_configs))]
+        return out if keep else None
+
+    def get_table(self, params: EmbedParams, tid: int,
+                  chunk_elems: int = CHECKPOINT_CHUNK_ELEMS,
+                  all_ranks: bool = True) -> Optional[np.ndarray]:
+        """Reassemble ONE global table on host (streamed like
+        :meth:`get_weights`, which delegates here). Lets checkpoint writers
+        cap host memory at one table instead of the whole model."""
         if not hasattr(self, "_ckpt_jit_cache"):
             self._ckpt_jit_cache = {}
-        is_chief = jax.process_index() == 0
-        keep = all_ranks or is_chief
+        keep = all_ranks or jax.process_index() == 0
         params = self.stacked_view(params)
-        out: List[Optional[np.ndarray]] = (
-            [None] * len(self.strategy.global_configs))
+        cfg = self.strategy.global_configs[tid]
+        out: Optional[np.ndarray] = None
         for r, rank_plan in enumerate(self._slice_plan()):
-            for tid, roff, rows, c0, w, rb in rank_plan:
+            for t2, roff, rows, c0, w, rb in rank_plan:
+                if t2 != tid:
+                    continue
                 v = params[_wkey(w)]
-                if keep and out[tid] is None:
-                    cfg = self.strategy.global_configs[tid]
-                    out[tid] = np.empty(
+                if keep and out is None:
+                    out = np.empty(
                         (int(cfg["input_dim"]), int(cfg["output_dim"])),
                         v.dtype)
                 p = ps.pack_factor(w)
@@ -1336,7 +1348,7 @@ class DistributedEmbedding:
                     phys = self._fetch_rows(
                         v, r, (roff + s) // p, -(-n // p), to_host=keep)
                     if keep:
-                        out[tid][rb + s:rb + s + n, c0:c0 + w] = \
+                        out[rb + s:rb + s + n, c0:c0 + w] = \
                             ps.unpack_rows_np(phys, w)[:n]
         return out if keep else None
 

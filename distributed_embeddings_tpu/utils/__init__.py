@@ -1,4 +1,5 @@
 """Data pipelines and metrics for the example models."""
 
+from .checkpoint import restore_train_state, save_train_state
 from .data import DummyDataset, RawBinaryDataset, power_law_ids
 from .metrics import binary_auc

@@ -1,0 +1,104 @@
+"""Full train-state checkpoint/resume (utils/checkpoint.py) — beyond the
+reference, which saves only embedding tables (SURVEY §5: "no optimizer-state
+or step checkpointing").
+
+The strong test: train K steps, save, restore into a FRESH DistributedEmbedding
+and train K more — the trajectory must equal an uninterrupted 2K-step run
+exactly (params, optimizer state, step counter all carried)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_embeddings_tpu.parallel import (
+    DistributedEmbedding, SparseAdagrad, SparseAdam, SparseSGD,
+    init_hybrid_state, make_hybrid_train_step)
+from distributed_embeddings_tpu.utils import (
+    restore_train_state, save_train_state)
+
+WORLD = 8
+B = 16
+
+
+def _setup():
+    # TWO width groups (4 and 16): multi-slab checkpoints must route each
+    # optimizer-state component to the right width (a lexicographic-vs-
+    # numeric wkey ordering bug once swapped Adam counts between groups)
+    configs = [{"input_dim": 20 + 5 * i, "output_dim": 4 if i % 2 else 16,
+                "combiner": ["sum", None, "mean"][i % 3]}
+               for i in range(10)]
+    de = DistributedEmbedding(configs, world_size=WORLD,
+                              strategy="memory_balanced")
+    mesh = Mesh(np.array(jax.devices()[:WORLD]), ("data",))
+    return configs, de, mesh
+
+
+def _data(rng, configs):
+    cats = []
+    for cfg in configs:
+        shape = (B,) if cfg["combiner"] is None else (B, 3)
+        cats.append(jnp.asarray(
+            rng.integers(0, cfg["input_dim"], size=shape), jnp.int32))
+    y = jnp.asarray(rng.normal(size=(B, 1)) * 0.1, jnp.float32)
+    return cats, y
+
+
+def _loss_fn(dp, emb_outs, batch):
+    x = jnp.concatenate([e.reshape(e.shape[0], -1) for e in emb_outs],
+                        axis=1)
+    return jnp.mean((x @ dp["w"] - batch) ** 2)
+
+
+@pytest.mark.parametrize("opt_name", ["sgd", "adagrad", "adam"])
+def test_save_restore_resumes_exact_trajectory(tmp_path, opt_name):
+    rng = np.random.default_rng(3)
+    configs, de, mesh = _setup()
+    emb_opt = {"sgd": SparseSGD(), "adagrad": SparseAdagrad(),
+               "adam": SparseAdam()}[opt_name]
+    tx = optax.sgd(0.4)
+    cols = sum(c["output_dim"] for c in configs)
+    dp = {"w": jnp.asarray(rng.normal(size=(cols, 1)) * 0.2, jnp.float32)}
+    cats, y = _data(rng, configs)
+    y_sh = jax.device_put(y, NamedSharding(mesh, P("data")))
+
+    step = make_hybrid_train_step(de, _loss_fn, tx, emb_opt, mesh=mesh,
+                                  lr_schedule=0.3)
+
+    # uninterrupted 2K-step reference run
+    ref = init_hybrid_state(de, emb_opt, jax.tree.map(jnp.copy, dp), tx,
+                            jax.random.key(1), mesh=mesh)
+    for _ in range(6):
+        _, ref = step(ref, cats, y_sh)
+    ref_tables = de.get_weights(ref.emb_params)
+
+    # interrupted run: 3 steps, save, restore into a FRESH wrapper, 3 more
+    st = init_hybrid_state(de, emb_opt, jax.tree.map(jnp.copy, dp), tx,
+                           jax.random.key(1), mesh=mesh)
+    for _ in range(3):
+        _, st = step(st, cats, y_sh)
+    ck = str(tmp_path / f"ck_{opt_name}")
+    save_train_state(ck, de, st)
+
+    de2 = DistributedEmbedding(configs, world_size=WORLD,
+                               strategy="memory_balanced")
+    st2 = restore_train_state(ck, de2, emb_opt,
+                              jax.tree.map(jnp.zeros_like, dp), tx,
+                              mesh=mesh)
+    assert int(st2.step) == 3
+    step2 = make_hybrid_train_step(de2, _loss_fn, tx, emb_opt, mesh=mesh,
+                                   lr_schedule=0.3)
+    for _ in range(3):
+        _, st2 = step2(st2, cats, y_sh)
+
+    got_tables = de2.get_weights(st2.emb_params)
+    for t, (a, b) in enumerate(zip(ref_tables, got_tables)):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7,
+                                   err_msg=f"table {t}")
+    for k in ("w",):
+        np.testing.assert_allclose(np.asarray(ref.dense_params[k]),
+                                   np.asarray(st2.dense_params[k]),
+                                   rtol=1e-6, atol=1e-7)
+    assert int(st2.step) == 6
