@@ -76,6 +76,11 @@ flags.DEFINE_float("auc_threshold", None,
 flags.DEFINE_integer("eval_batches", 4,
                      "synthetic evaluation batches when no dataset is given "
                      "(a real dataset evaluates its full validation split)")
+flags.DEFINE_string("save_state", None,
+                    "directory for a FULL train-state checkpoint (tables + "
+                    "sparse-optimizer state + dense + step; resumable via "
+                    "utils.restore_train_state) in addition to the "
+                    "reference-style embedding-weights dump")
 
 
 def synthetic_batches(cfg, num_batches, batch_size, seed=0):
@@ -257,6 +262,11 @@ def main(_):
     if is_chief:
         np.savez(FLAGS.checkpoint_out, *weights)
         print("saved", len(weights), "tables to", FLAGS.checkpoint_out)
+    if FLAGS.save_state:
+        from distributed_embeddings_tpu.utils import save_train_state
+        save_train_state(FLAGS.save_state, de, state)
+        if is_chief:
+            print("saved full train state to", FLAGS.save_state)
 
 
 if __name__ == "__main__":
