@@ -81,6 +81,10 @@ flags.DEFINE_string("save_state", None,
                     "sparse-optimizer state + dense + step; resumable via "
                     "utils.restore_train_state) in addition to the "
                     "reference-style embedding-weights dump")
+flags.DEFINE_string("restore_state", None,
+                    "resume from a --save_state checkpoint directory "
+                    "(restores tables, sparse-optimizer state, dense "
+                    "params/optimizer and the step counter)")
 
 
 def synthetic_batches(cfg, num_batches, batch_size, seed=0):
@@ -148,8 +152,16 @@ def main(_):
         n, y = batch
         return bce_with_logits(dense.apply(dp, n, emb_outs), y)
 
-    state = init_hybrid_state(de, emb_opt, dense_params, tx,
-                              jax.random.key(1), mesh=mesh)
+    if FLAGS.restore_state:
+        from distributed_embeddings_tpu.utils import restore_train_state
+        state = restore_train_state(FLAGS.restore_state, de, emb_opt,
+                                    dense_params, tx, mesh=mesh)
+        if is_chief:
+            print("restored train state at step", int(state.step),
+                  "from", FLAGS.restore_state)
+    else:
+        state = init_hybrid_state(de, emb_opt, dense_params, tx,
+                                  jax.random.key(1), mesh=mesh)
     step_fn = make_hybrid_train_step(de, loss_fn, tx, emb_opt, mesh=mesh,
                                      lr_schedule=sched)
 
