@@ -1396,10 +1396,37 @@ class DistributedEmbedding:
                         base + (roff + s) // p)
         return buf.reshape(shape3)
 
+    @staticmethod
+    def _uid_lock_path() -> str:
+        """Per-uid fallback lock name — a fixed world-shared /tmp name
+        would collide with, or be blocked by, other users' pre-existing
+        lock files on a shared host (ADVICE r4)."""
+        import tempfile
+        return os.path.join(tempfile.gettempdir(),
+                            f"detpu_set_weights_{os.getuid()}.lock")
+
+    @classmethod
+    def _lock_path(cls, weights) -> str:
+        """Lock file for ``set_weights(use_lock=True)``. Path sources lock
+        on a name derived from the (resolved) checkpoint directory — every
+        loader of one checkpoint agrees on the lock file regardless of who
+        owns the directory, and unrelated loads don't contend. Array
+        sources (no stable identity) fall back to the per-uid name."""
+        import hashlib
+        import tempfile
+        for w in weights:
+            if isinstance(w, str):
+                d = os.path.dirname(os.path.realpath(w))
+                h = hashlib.sha256(d.encode()).hexdigest()[:16]
+                return os.path.join(tempfile.gettempdir(),
+                                    f"detpu_set_weights_{h}.lock")
+        return cls._uid_lock_path()
+
     def set_weights(self, weights: Sequence[Any], mesh=None,
                     dtype=jnp.float32,
                     chunk_elems: int = CHECKPOINT_CHUNK_ELEMS,
-                    use_lock: bool = False) -> EmbedParams:
+                    use_lock: bool = False,
+                    src_dtype=None) -> EmbedParams:
         """Build the sharded slab dict from full global tables (numpy arrays
         or ``np.load``-able paths, mmap'd like the reference,
         ``dist_model_parallel.py:337-339``).
@@ -1418,9 +1445,25 @@ class DistributedEmbedding:
         (``dist_model_parallel.py:362-380``) — so peak transient host memory
         is one chunk regardless of model size, and >2^31-element tables never
         hit a single oversized transfer. On multi-host meshes each process
-        builds only its addressable shards."""
+        builds only its addressable shards.
+
+        ``src_dtype``: the dtype ``.npy`` sources were SAVED in. ``np.save``
+        of an extension dtype (bfloat16) writes an opaque void descriptor
+        that ``np.load`` cannot map back — such sources load as ``|V<n>``
+        and are re-viewed as ``src_dtype`` here (required for bf16
+        checkpoints; ``utils.checkpoint`` records it in ``meta.json``)."""
         loaded = [np.load(w, mmap_mode="r") if isinstance(w, str)
                   else np.asarray(w) for w in weights]
+        if any(a.dtype.kind == "V" for a in loaded):
+            if src_dtype is None:
+                raise ValueError(
+                    "sources carry an opaque (void) dtype — np.save of an "
+                    "extension dtype like bfloat16 does not round-trip "
+                    "through np.load; pass src_dtype= with the dtype they "
+                    "were saved in")
+            sdt = jnp.dtype(src_dtype)  # np.dtype instance (ml_dtypes-aware)
+            loaded = [a.view(sdt) if a.dtype.kind == "V" else a
+                      for a in loaded]
         if len(loaded) != len(self.strategy.global_configs):
             raise ValueError("set_weights needs one array per global table")
         for tid, (src, cfg) in enumerate(
@@ -1435,9 +1478,12 @@ class DistributedEmbedding:
         lock_file = None
         if use_lock:
             import fcntl
-            import tempfile
-            lock_file = open(os.path.join(
-                tempfile.gettempdir(), "detpu_set_weights.lock"), "w")
+            try:
+                lock_file = open(self._lock_path(weights), "w")
+            except PermissionError:
+                # another user owns the shared-name lock file: degrade to
+                # per-uid scope rather than failing the load outright
+                lock_file = open(self._uid_lock_path(), "w")
             fcntl.flock(lock_file, fcntl.LOCK_EX)
         try:
             out = {}

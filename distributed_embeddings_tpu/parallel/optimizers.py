@@ -132,14 +132,22 @@ class SparseAdagrad:
 
     def apply_rows(self, slab: jax.Array, accum: jax.Array, ids: jax.Array,
                    vals: jax.Array, lr):
-        vals = vals.astype(slab.dtype)
+        # moments accumulate in the ACCUMULATOR dtype: with bf16 tables +
+        # fp32 accumulators, g*g must square in fp32 or the carefully
+        # preserved fp32 state would hold bf16-precision statistics
+        vals = vals.astype(accum.dtype)
         if (self.dense_apply_ratio is not None
                 and vals.shape[0] * self.dense_apply_ratio > slab.shape[0]):
             # dense-apply regime: one scatter-sum, then elementwise Adagrad
             # over the slab (exact — untouched rows see g=0, a no-op)
-            g = _sorted_scatter_add(jnp.zeros_like(slab), ids, vals)
+            g = _sorted_scatter_add(jnp.zeros(slab.shape, accum.dtype),
+                                    ids, vals)
             new_acc = accum + g * g
-            slab = slab - lr * g * lax.rsqrt(new_acc + self.eps)
+            # update computes in the accumulator dtype but must not promote
+            # the slab (bf16 tables + fp32 accumulators would silently turn
+            # fp32 here where the sparse regime's scatter keeps bf16)
+            slab = slab - (lr * g * lax.rsqrt(new_acc + self.eps)
+                           ).astype(slab.dtype)
             return slab, new_acc
         # nonlinear in g: must sum duplicate rows before the rsqrt.
         # vocab bound: distinct physical rows <= slab rows + sentinel, so
@@ -155,8 +163,10 @@ class SparseAdagrad:
         # the fast path and drops every sentinel copy out of bounds.
         accum = accum.at[uids].set(new_acc, mode="drop",
                                    indices_are_sorted=True)
-        # optax scale_by_rss semantics: g * rsqrt(acc_new + eps)
-        update = lr * uvals * lax.rsqrt(new_acc + self.eps)
+        # optax scale_by_rss semantics: g * rsqrt(acc_new + eps); computed
+        # in the accumulator dtype, cast to the slab's (mixed bf16/fp32)
+        update = (lr * uvals * lax.rsqrt(new_acc + self.eps)
+                  ).astype(slab.dtype)
         slab = slab.at[uids].add(-update, mode="drop",
                                  indices_are_sorted=True)
         return slab, accum
@@ -217,7 +227,7 @@ class SparseMomentum:
 
     def apply_rows(self, slab: jax.Array, trace: jax.Array, ids: jax.Array,
                    vals: jax.Array, lr, mask=None, lane_width=None):
-        vals = vals.astype(slab.dtype)
+        vals = vals.astype(trace.dtype)  # momentum state sets the precision
         # read-modify-write of per-row trace: duplicates must sum first
         uids, uvals, touched = _dedup_with_mask(
             ids, vals, mask, lane_width, pad_id=slab.shape[0])
@@ -230,8 +240,8 @@ class SparseMomentum:
         step = (uvals + self.momentum * t_new) if self.nesterov else t_new
         if touched is not None:
             step = jnp.where(touched, step, 0.0)
-        slab = slab.at[uids].add(-lr * step, mode="drop",
-                                 indices_are_sorted=True)
+        slab = slab.at[uids].add((-lr * step).astype(slab.dtype),
+                                 mode="drop", indices_are_sorted=True)
         return slab, trace
 
 
@@ -261,7 +271,7 @@ class SparseAdam:
     def apply_rows(self, slab: jax.Array, state, ids: jax.Array,
                    vals: jax.Array, lr, mask=None, lane_width=None):
         mu, nu, count = state
-        vals = vals.astype(slab.dtype)
+        vals = vals.astype(mu.dtype)  # moments set the precision
         uids, uvals, touched = _dedup_with_mask(
             ids, vals, mask, lane_width, pad_id=slab.shape[0])
         count = count + 1.0
@@ -280,6 +290,6 @@ class SparseAdam:
         update = lr * mu_hat / (jnp.sqrt(nu_hat + self.eps_root) + self.eps)
         if touched is not None:
             update = jnp.where(touched, update, 0.0)
-        slab = slab.at[uids].add(-update, mode="drop",
+        slab = slab.at[uids].add(-update.astype(slab.dtype), mode="drop",
                                  indices_are_sorted=True)
         return slab, (mu, nu, count)

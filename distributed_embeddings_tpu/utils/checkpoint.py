@@ -108,53 +108,89 @@ def save_train_state(path: str, de, state: HybridTrainState,
         dump_tables(os.path.join("emb_opt", name), comp)
     if is_chief:
         os.makedirs(os.path.join(path, "emb_opt"), exist_ok=True)
-        # record the width-key order the aux rows are stacked in — dict
-        # order is lexicographic ('w16' < 'w4'), NOT numeric
-        wkey_order = sorted(next(iter(aux.values()))) if aux else []
+        # aux components save per width key (one npz entry each) — stacking
+        # across keys would require every key's aux leaf to have the same
+        # element count, which only holds for scalar counters (ADVICE r4)
         for name, comp in aux.items():
-            np.save(os.path.join(path, "emb_opt", f"{name}.npy"),
-                    np.stack([np.asarray(comp[k]).reshape(-1)
-                              for k in wkey_order]))
+            np.savez(os.path.join(path, "emb_opt", f"{name}.npz"),
+                     **{k: np.asarray(v) for k, v in comp.items()})
         dense = {"dense_params": state.dense_params,
                  "dense_opt_state": state.dense_opt_state,
                  "step": state.step}
         with open(os.path.join(path, "dense.msgpack"), "wb") as f:
             f.write(serialization.to_bytes(dense))
+
+        def dt(tree):
+            return str(jnp.dtype(next(iter(tree.values())).dtype).name)
+
         meta = {"num_tables": n_tables,
                 "slab_components": sorted(slabs),
                 "aux_components": sorted(aux),
-                "aux_wkey_order": wkey_order}
+                # per-component saved dtypes: a bf16-tables + fp32-accumulator
+                # run must restore with the SAME mixed dtypes by default
+                # (ADVICE r4) — restore reads these unless overridden
+                "dtypes": {"tables": dt(state.emb_params),
+                           **{name: dt(comp)
+                              for name, comp in slabs.items()}}}
         with open(os.path.join(path, "meta.json"), "w") as f:
             json.dump(meta, f)
 
 
 def restore_train_state(path: str, de, emb_optimizer, dense_template,
                         dense_tx, mesh=None,
-                        dtype=jnp.float32) -> HybridTrainState:
+                        dtype=None) -> HybridTrainState:
     """Rebuild a :class:`HybridTrainState` from :func:`save_train_state`
     output. ``dense_template`` supplies the dense params/opt pytree
     structure (e.g. a freshly initialized state's ``dense_params``);
-    tables restore via mmap'd streaming ``set_weights``."""
+    tables restore via mmap'd streaming ``set_weights``.
+
+    ``dtype``: by default every component restores in the dtype it was
+    SAVED in (recorded in ``meta.json`` — a bf16-tables + fp32-accumulator
+    run resumes with the same mixed dtypes and an unchanged trajectory).
+    Pass a single dtype to force it everywhere, or a dict keyed by
+    component name (``"tables"``, ``"state"``, ``"state0"``, ...) for
+    per-component overrides (missing keys keep their saved dtype)."""
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
     n = meta["num_tables"]
+    saved_dtypes = meta.get("dtypes", {})
+
+    def saved(component):  # the dtype files were written in (also the view
+        # hint for bf16 .npy, whose descriptor np.load cannot map back)
+        return jnp.dtype(saved_dtypes.get(component, "float32"))
+
+    def dtype_of(component):
+        if isinstance(dtype, dict):
+            if component in dtype:
+                return dtype[component]
+        elif dtype is not None:
+            return dtype
+        return saved(component)
 
     def table_paths(sub):
         return [os.path.join(path, sub, f"table_{t:03d}.npy")
                 for t in range(n)]
 
     emb_params = de.set_weights(table_paths("tables"), mesh=mesh,
-                                dtype=dtype)
+                                dtype=dtype_of("tables"),
+                                src_dtype=saved("tables"))
     # inspect the optimizer-state STRUCTURE without materializing it (a
     # real init would transiently allocate full slab-sized moments)
     opt_struct = jax.eval_shape(emb_optimizer.init, emb_params)
     slab_comps = {
         name: de.set_weights(table_paths(os.path.join("emb_opt", name)),
-                             mesh=mesh, dtype=dtype)
+                             mesh=mesh, dtype=dtype_of(name),
+                             src_dtype=saved(name))
         for name in meta["slab_components"]}
-    aux_comps = {
-        name: np.load(os.path.join(path, "emb_opt", f"{name}.npy"))
-        for name in meta["aux_components"]}
+    aux_comps = {}
+    for name in meta["aux_components"]:
+        npz = os.path.join(path, "emb_opt", f"{name}.npz")
+        if os.path.exists(npz):
+            aux_comps[name] = dict(np.load(npz))
+        else:  # pre-r5 stacked format: rows in aux_wkey_order
+            rows = np.load(os.path.join(path, "emb_opt", f"{name}.npy"))
+            aux_comps[name] = {
+                k: rows[i] for i, k in enumerate(meta["aux_wkey_order"])}
     if _is_slab_dict(opt_struct, emb_params):
         assert set(meta["slab_components"]) == {"state"}, meta
         opt_state = slab_comps["state"]
@@ -169,10 +205,8 @@ def restore_train_state(path: str, de, emb_optimizer, dense_template,
                     parts.append(slab_comps[name][k])
                 else:
                     spec = opt_struct[k][i]
-                    row = aux_comps[name][
-                        meta["aux_wkey_order"].index(k)]
-                    parts.append(jnp.asarray(row).reshape(spec.shape)
-                                 .astype(spec.dtype))
+                    parts.append(jnp.asarray(aux_comps[name][k])
+                                 .reshape(spec.shape).astype(spec.dtype))
             new[k] = tuple(parts)
         opt_state = new
     else:

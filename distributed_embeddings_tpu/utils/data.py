@@ -102,6 +102,10 @@ class RawBinaryDataset:
       drop_last_batch: drop the trailing partial batch.
       valid: read the ``test`` split.
       prefetch_depth: background-thread read-ahead.
+      start_batch: iteration begins at this batch index (random access via
+        the memmaps, no replay cost) — lets a resumed run continue the data
+        stream where the checkpointed step left off instead of re-training
+        the early batches with a late-step LR (ADVICE r4).
     """
 
     def __init__(self, data_path: str, batch_size: int = 1,
@@ -110,7 +114,7 @@ class RawBinaryDataset:
                  categorical_feature_sizes: Optional[Sequence[int]] = None,
                  prefetch_depth: int = 10, drop_last_batch: bool = False,
                  valid: bool = False, offset: int = -1, lbs: int = -1,
-                 dp_input: bool = False):
+                 dp_input: bool = False, start_batch: int = 0):
         split_dir = os.path.join(data_path, "test" if valid else "train")
         self._batch_size = batch_size
         self._num_numerical = numerical_features
@@ -143,9 +147,17 @@ class RawBinaryDataset:
                 raise ValueError(f"cat_{cid}.bin row count mismatch")
             self._cat_maps.append(m)
 
+        # NOT wrapped modulo the epoch: resuming a checkpoint saved at run
+        # completion (step == num batches) must yield an EMPTY stream, not
+        # silently retrain an extra epoch; multi-epoch drivers pass
+        # ``step % len(ds)`` themselves
+        self._start_batch = int(start_batch)
         self._prefetch_depth = min(prefetch_depth, self._num_entries)
 
     def __len__(self):
+        # full-epoch batch count; iteration with start_batch > 0 yields
+        # len(self) - start_batch items (absolute __getitem__ indexing is
+        # unaffected)
         return self._num_entries
 
     def _read(self, idx: int):
@@ -171,7 +183,7 @@ class RawBinaryDataset:
 
     def __iter__(self):
         if self._prefetch_depth <= 1:
-            for i in range(self._num_entries):
+            for i in range(self._start_batch, self._num_entries):
                 yield self._read(i)
             return
 
@@ -198,7 +210,7 @@ class RawBinaryDataset:
             # the consumer — a silently dead producer would leave the
             # consumer blocked on q.get() forever.
             try:
-                for i in range(self._num_entries):
+                for i in range(self._start_batch, self._num_entries):
                     if not put_until_stopped(self._read(i)):
                         return
                 put_until_stopped(None)

@@ -209,7 +209,11 @@ def main(_):
             numerical_features=FLAGS.num_numerical_features,
             categorical_features=list(range(len(table_sizes))),
             categorical_feature_sizes=table_sizes,
-            drop_last_batch=True, dp_input=not use_mp_input)
+            drop_last_batch=True, dp_input=not use_mp_input,
+            # resume continues the data stream where the checkpointed step
+            # left off (modulo epoch) instead of replaying early batches
+            # with a late-step LR
+            start_batch=int(state.step))
         eval_data = RawBinaryDataset(
             data_path=FLAGS.dataset_path, batch_size=FLAGS.batch_size,
             numerical_features=FLAGS.num_numerical_features,
@@ -247,7 +251,11 @@ def main(_):
     # flag-driven mid-training eval cadence with an MLPerf-style AUC stop
     # target (VERDICT r3 Missing #3)
     stopped = False
-    for step, (num, cats, labels) in enumerate(train_iter):
+    # resume numbers steps globally: the data stream already starts at
+    # state.step, so logging/eval cadence stays aligned with the
+    # uninterrupted run
+    for step, (num, cats, labels) in enumerate(train_iter,
+                                               start=int(state.step)):
         loss, state = step_fn(state, prep_cats(cats), prep_batch(num, labels))
         if step % 1000 == 0 and is_chief:
             print("step:", step, " loss:", float(loss))

@@ -102,3 +102,32 @@ def test_save_restore_resumes_exact_trajectory(tmp_path, opt_name):
                                    np.asarray(st2.dense_params[k]),
                                    rtol=1e-6, atol=1e-7)
     assert int(st2.step) == 6
+
+
+def test_restore_preserves_saved_dtypes(tmp_path):
+    """bf16 tables + fp32 Adagrad accumulators restore with the SAME mixed
+    dtypes by default — one forced dtype would silently alter the
+    trajectory of a mixed-precision run (ADVICE r4)."""
+    configs, de, mesh = _setup()
+    emb_opt = SparseAdagrad()
+    tx = optax.sgd(0.1)
+    dp = {"w": jnp.zeros((sum(c["output_dim"] for c in configs), 1),
+                         jnp.float32)}
+    st = init_hybrid_state(de, emb_opt, dp, tx, jax.random.key(0),
+                           mesh=mesh)
+    # mixed precision: bf16 tables, fp32 accumulators
+    st = st._replace(emb_params=jax.tree.map(
+        lambda a: a.astype(jnp.bfloat16), st.emb_params))
+    ck = str(tmp_path / "ck_mixed")
+    save_train_state(ck, de, st)
+
+    de2 = DistributedEmbedding(configs, world_size=WORLD,
+                               strategy="memory_balanced")
+    st2 = restore_train_state(ck, de2, emb_opt, dp, tx, mesh=mesh)
+    assert all(v.dtype == jnp.bfloat16 for v in st2.emb_params.values())
+    assert all(v.dtype == jnp.float32 for v in st2.emb_opt_state.values())
+    # explicit per-component override still wins
+    st3 = restore_train_state(ck, de2, emb_opt, dp, tx, mesh=mesh,
+                              dtype={"tables": jnp.float32})
+    assert all(v.dtype == jnp.float32 for v in st3.emb_params.values())
+    assert all(v.dtype == jnp.float32 for v in st3.emb_opt_state.values())
