@@ -22,6 +22,14 @@ Two training modes over the SAME layer and parameters:
   :class:`~.parallel.optimizers.SparseAdagrad` — same parameter pytree, so
   checkpoints interchange freely.
 
+For single-table / op-layer models (``layers.Embedding`` over plain
+``[vocab, width]`` tables, no executor), a third route keeps BOTH plain
+optax composability and O(touched rows) updates:
+:func:`~.parallel.sparse_optax.sparse_value_and_grad` +
+``sparse_rows_*`` transforms — the op-layer IndexedSlices pipeline
+(reference ``embedding_lookup_ops.py:105-122``), see
+``parallel/sparse_optax.py``.
+
 Autodiff contract note: the forward clips out-of-range ids into the last row
 (module contract, see ``parallel/dist_embedding.py``), so plain autodiff
 *trains* that clipped row on bad ids where the sparse backward *drops* them.

@@ -17,6 +17,16 @@ from .grads import (
     split_mp_dp,
 )
 from .optimizers import SparseAdagrad, SparseAdam, SparseMomentum, SparseSGD
+from .sparse_optax import (
+    SparseRows,
+    apply_sparse_updates,
+    sparse_rows_adagrad,
+    sparse_rows_adam,
+    sparse_rows_momentum,
+    sparse_rows_sgd,
+    sparse_value_and_grad,
+    unique_ids_static,
+)
 from .trainer import (
     HybridTrainState,
     init_hybrid_state,

@@ -1398,29 +1398,20 @@ class DistributedEmbedding:
 
     @staticmethod
     def _uid_lock_path() -> str:
-        """Per-uid fallback lock name — a fixed world-shared /tmp name
-        would collide with, or be blocked by, other users' pre-existing
-        lock files on a shared host (ADVICE r4)."""
+        """Lock file for ``set_weights(use_lock=True)``: ONE lock per uid,
+        so every concurrent load by this user serializes — the reference's
+        ``use_lock`` likewise serializes ranks globally, not per
+        checkpoint (``dist_model_parallel.py:329-331``). Scoped per uid
+        because a fixed world-shared /tmp name would collide with, or be
+        blocked by, other users' pre-existing lock files on a shared host
+        (ADVICE r4). A per-checkpoint name was considered and rejected:
+        one restore streams several component directories (tables/,
+        emb_opt/*) whose loads must ALL serialize against other
+        processes' — a directory-derived name would hand them different
+        locks."""
         import tempfile
         return os.path.join(tempfile.gettempdir(),
                             f"detpu_set_weights_{os.getuid()}.lock")
-
-    @classmethod
-    def _lock_path(cls, weights) -> str:
-        """Lock file for ``set_weights(use_lock=True)``. Path sources lock
-        on a name derived from the (resolved) checkpoint directory — every
-        loader of one checkpoint agrees on the lock file regardless of who
-        owns the directory, and unrelated loads don't contend. Array
-        sources (no stable identity) fall back to the per-uid name."""
-        import hashlib
-        import tempfile
-        for w in weights:
-            if isinstance(w, str):
-                d = os.path.dirname(os.path.realpath(w))
-                h = hashlib.sha256(d.encode()).hexdigest()[:16]
-                return os.path.join(tempfile.gettempdir(),
-                                    f"detpu_set_weights_{h}.lock")
-        return cls._uid_lock_path()
 
     def set_weights(self, weights: Sequence[Any], mesh=None,
                     dtype=jnp.float32,
@@ -1478,12 +1469,7 @@ class DistributedEmbedding:
         lock_file = None
         if use_lock:
             import fcntl
-            try:
-                lock_file = open(self._lock_path(weights), "w")
-            except PermissionError:
-                # another user owns the shared-name lock file: degrade to
-                # per-uid scope rather than failing the load outright
-                lock_file = open(self._uid_lock_path(), "w")
+            lock_file = open(self._uid_lock_path(), "w")
             fcntl.flock(lock_file, fcntl.LOCK_EX)
         try:
             out = {}

@@ -1,0 +1,309 @@
+"""Op-layer sparse gradients + optax bridge (parallel/sparse_optax.py).
+
+Reference behavior being matched: the registered lookup gradient returns
+IndexedSlices even on one device (embedding_lookup_ops.py:105-122), so any
+optimizer updates only touched rows. Tests:
+
+* gradient parity: sparse (unique_ids, unique_grad) scattered dense equals
+  plain autodiff through embedding_lookup, for dense/ragged/sparse inputs,
+  all combiners, shared tables;
+* trajectory parity vs dense optax when every row is touched (sgd/adagrad/
+  momentum/adam numerics);
+* trajectory parity vs the hybrid trainer path (the same lazy semantics);
+* O(touched-rows) memory: a jitted train step over a table whose dense
+  gradient would dominate memory compiles with temporaries a small
+  fraction of the table size.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_embeddings_tpu.ops import Ragged, SparseIds, embedding_lookup
+from distributed_embeddings_tpu.parallel import (
+    DistributedEmbedding, SparseAdagrad, SparseRows, SparseSGD,
+    apply_sparse_updates, init_hybrid_state, make_hybrid_train_step,
+    sparse_rows_adagrad, sparse_rows_adam, sparse_rows_momentum,
+    sparse_rows_sgd, sparse_value_and_grad, unique_ids_static)
+
+
+def _scatter_dense(sg: SparseRows) -> np.ndarray:
+    out = np.zeros((sg.vocab, sg.rows.shape[1]), np.float32)
+    ids = np.asarray(sg.ids)
+    rows = np.asarray(sg.rows, np.float32)
+    for k in range(len(ids)):
+        if ids[k] < sg.vocab:
+            out[ids[k]] += rows[k]
+    return out
+
+
+def test_unique_ids_static_roundtrip():
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 13, size=57), jnp.int32)
+    uids, inv = unique_ids_static(ids, 13)
+    assert uids.shape[0] == min(57, 14)
+    np.testing.assert_array_equal(np.asarray(uids)[np.asarray(inv)],
+                                  np.asarray(ids))
+    u = np.asarray(uids)
+    valid = u[u < 13]
+    np.testing.assert_array_equal(valid, np.unique(np.asarray(ids)))
+
+
+@pytest.mark.parametrize("combiner", [None, "sum", "mean"])
+def test_grad_parity_dense_inputs(combiner):
+    rng = np.random.default_rng(1)
+    vocab, w, b = 40, 8, 16
+    table = jnp.asarray(rng.normal(size=(vocab, w)), jnp.float32)
+    shape = (b,) if combiner is None else (b, 3)
+    ids = jnp.asarray(rng.integers(0, vocab, size=shape), jnp.int32)
+    tgt = jnp.asarray(rng.normal(size=(b, w)), jnp.float32)
+
+    def loss_fn(dp, outs, t):
+        return jnp.mean((outs[0] * dp["s"] - t) ** 2)
+
+    dp = {"s": jnp.float32(1.3)}
+    f = sparse_value_and_grad(loss_fn, combiners=[combiner])
+    loss, (dgrads, sgrads) = f(dp, [table], [ids], tgt)
+
+    def ref(dpp, tbl):
+        return loss_fn(dpp, [embedding_lookup(tbl, ids, combiner=combiner)],
+                       tgt)
+
+    rloss, (rdg, rtg) = jax.value_and_grad(ref, argnums=(0, 1))(dp, table)
+    np.testing.assert_allclose(float(loss), float(rloss), rtol=1e-6)
+    np.testing.assert_allclose(float(dgrads["s"]), float(rdg["s"]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(_scatter_dense(sgrads[0]), np.asarray(rtg),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_grad_parity_ragged_sparse_and_shared_table():
+    rng = np.random.default_rng(2)
+    vocab, w, b = 30, 4, 8
+    table = jnp.asarray(rng.normal(size=(vocab, w)), jnp.float32)
+    ragged = Ragged.from_lists(
+        [list(rng.integers(0, vocab, size=rng.integers(1, 5)))
+         for _ in range(b)], capacity=40)
+    rows = np.sort(rng.integers(0, b, size=12))
+    coo = SparseIds(
+        indices=jnp.asarray(np.stack([rows, np.arange(12) % 3], 1),
+                            jnp.int32),
+        values=jnp.asarray(rng.integers(0, vocab, size=12), jnp.int32),
+        dense_shape=(b, 3))
+    tgt = jnp.asarray(rng.normal(size=(b, w)), jnp.float32)
+
+    def loss_fn(dp, outs, t):
+        del dp
+        return jnp.mean((outs[0] + 2.0 * outs[1] - t) ** 2)
+
+    # two inputs SHARING one table: joint dedup, one SparseRows out
+    f = sparse_value_and_grad(loss_fn, combiners=["mean"],
+                              input_table_map=[0, 0])
+    loss, (_, sgrads) = f({}, [table], [ragged, coo], tgt)
+    assert len(sgrads) == 1
+
+    def ref(tbl):
+        return loss_fn({}, [embedding_lookup(tbl, ragged, combiner="mean"),
+                            embedding_lookup(tbl, coo, combiner="mean")],
+                       tgt)
+
+    rloss, rtg = jax.value_and_grad(ref)(table)
+    np.testing.assert_allclose(float(loss), float(rloss), rtol=1e-6)
+    np.testing.assert_allclose(_scatter_dense(sgrads[0]), np.asarray(rtg),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("kind", ["sgd", "adagrad", "momentum", "adam"])
+def test_trajectory_matches_dense_optax_when_all_rows_touched(kind):
+    """With every row touched every step, lazy == dense semantics and the
+    sparse transforms must reproduce optax trajectories exactly."""
+    rng = np.random.default_rng(3)
+    vocab, w, b = 6, 4, 24  # b >> vocab: all rows touched w.h.p.
+    table0 = jnp.asarray(rng.normal(size=(vocab, w)), jnp.float32)
+    sched = lambda step: 0.1 / (1.0 + 0.1 * step)
+    tx, ref_tx = {
+        "sgd": (sparse_rows_sgd(sched), optax.sgd(sched)),
+        "adagrad": (sparse_rows_adagrad(sched),
+                    optax.adagrad(sched, initial_accumulator_value=0.1,
+                                  eps=1e-7)),
+        "momentum": (sparse_rows_momentum(sched, momentum=0.8),
+                     optax.sgd(sched, momentum=0.8)),
+        "adam": (sparse_rows_adam(sched), optax.adam(sched)),
+    }[kind]
+
+    def loss_fn(dp, outs, t):
+        del dp
+        return jnp.mean((outs[0] - t) ** 2)
+
+    f = sparse_value_and_grad(loss_fn, combiners=["sum"])
+
+    table = table0
+    state = tx.init([table])
+    rtable = table0
+    rstate = ref_tx.init(rtable)
+    for step in range(5):
+        ids = jnp.asarray(
+            np.concatenate([np.arange(vocab),
+                            rng.integers(0, vocab, size=b - vocab)]
+                           ).reshape(b // 2, 2), jnp.int32)
+        tgt = jnp.asarray(rng.normal(size=(b // 2, w)), jnp.float32)
+        _, (_, sgrads) = f({}, [table], [ids], tgt)
+        upd, state = tx.update(sgrads, state, [table])
+        [table] = apply_sparse_updates([table], upd)
+
+        def ref(tbl):
+            return loss_fn({}, [embedding_lookup(tbl, ids, combiner="sum")],
+                           tgt)
+
+        rg = jax.grad(ref)(rtable)
+        rupd, rstate = ref_tx.update(rg, rstate, rtable)
+        rtable = optax.apply_updates(rtable, rupd)
+        np.testing.assert_allclose(np.asarray(table), np.asarray(rtable),
+                                   rtol=2e-5, atol=1e-6,
+                                   err_msg=f"{kind} step {step}")
+
+
+@pytest.mark.parametrize("kind", ["sgd", "adagrad"])
+def test_trajectory_matches_hybrid_path(kind):
+    """The optax route and the hybrid trainer route implement the SAME
+    sparse semantics: identical configs + data must give identical tables
+    (VERDICT r4 #4 parity criterion)."""
+    rng = np.random.default_rng(4)
+    configs = [{"input_dim": 25 + 3 * i, "output_dim": 8, "combiner": "sum"}
+               for i in range(4)]
+    lr = 0.2
+    de = DistributedEmbedding(configs, world_size=1)
+    emb_opt = {"sgd": SparseSGD(), "adagrad": SparseAdagrad()}[kind]
+    # dense side: a fixed linear readout, plain SGD both routes
+    cols = sum(c["output_dim"] for c in configs)
+    dp0 = {"w": jnp.asarray(rng.normal(size=(cols, 1)) * 0.3, jnp.float32)}
+    dtx = optax.sgd(lr)
+
+    def loss_fn(dp, outs, y):
+        x = jnp.concatenate([o.reshape(o.shape[0], -1) for o in outs], 1)
+        return jnp.mean((x @ dp["w"] - y) ** 2)
+
+    # --- hybrid route
+    state = init_hybrid_state(de, emb_opt, jax.tree.map(jnp.copy, dp0), dtx,
+                              jax.random.key(7))
+    step_fn = make_hybrid_train_step(de, loss_fn, dtx, emb_opt,
+                                     lr_schedule=lr)
+    # --- optax route, seeded with the SAME initial tables
+    tables = [jnp.asarray(t) for t in de.get_weights(state.emb_params)]
+    tx = {"sgd": sparse_rows_sgd(lr), "adagrad": sparse_rows_adagrad(lr)}[
+        kind]
+    est = tx.init(tables)
+    dp = jax.tree.map(jnp.copy, dp0)
+    dst = dtx.init(dp)
+    f = sparse_value_and_grad(loss_fn,
+                              combiners=[c["combiner"] for c in configs])
+
+    b = 16
+    for _ in range(4):
+        cats = [jnp.asarray(rng.integers(0, c["input_dim"], size=(b, 2)),
+                            jnp.int32) for c in configs]
+        y = jnp.asarray(rng.normal(size=(b, 1)), jnp.float32)
+        _, state = step_fn(state, cats, y)
+        _, (dg, sg) = f(dp, tables, cats, y)
+        du, dst = dtx.update(dg, dst, dp)
+        dp = optax.apply_updates(dp, du)
+        su, est = tx.update(sg, est, tables)
+        tables = apply_sparse_updates(tables, su)
+
+    hyb = de.get_weights(state.emb_params)
+    for t, (a, b_) in enumerate(zip(hyb, tables)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=f"table {t}")
+    np.testing.assert_allclose(np.asarray(state.dense_params["w"]),
+                               np.asarray(dp["w"]), rtol=1e-5, atol=1e-6)
+
+
+def test_tuple_structured_params_tree():
+    """Structural tuples in the params tree must NOT be confused with the
+    transforms' internal per-leaf result packing (a tuple-as-leaf unpack
+    once returned optimizer state as the update)."""
+    w = jnp.ones((3, 2), jnp.float32)
+    b = jnp.ones((2,), jnp.float32)
+    params = {"dense": (w, b)}
+    grads = {"dense": (jnp.full_like(w, 0.5), jnp.full_like(b, 0.5))}
+    for tx, ref_tx in [
+            (sparse_rows_adagrad(0.1),
+             optax.adagrad(0.1, initial_accumulator_value=0.1, eps=1e-7)),
+            (sparse_rows_momentum(0.1, momentum=0.9),
+             optax.sgd(0.1, momentum=0.9)),
+            (sparse_rows_adam(0.1), optax.adam(0.1))]:
+        st = tx.init(params)
+        upd, _ = tx.update(grads, st, params)
+        rst = ref_tx.init(params)
+        rupd, _ = ref_tx.update(grads, rst, params)
+        jax.tree.map(
+            lambda a, b_: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), rtol=1e-6, atol=1e-7),
+            upd, rupd)
+
+
+def test_out_of_range_ids_train_nothing_and_stay_sorted():
+    """Ids >= vocab must not break the sorted-uids invariant and must not
+    touch any row (forward reads the clipped last row, like the op
+    layer)."""
+    vocab, w = 10, 4
+    table = jnp.asarray(np.arange(vocab * w).reshape(vocab, w), jnp.float32)
+    ids = jnp.asarray([[3, vocab + 7], [vocab + 200, 3]], jnp.int32)
+
+    def loss_fn(dp, outs, t):
+        del dp
+        return jnp.sum(outs[0] * t)
+
+    f = sparse_value_and_grad(loss_fn, combiners=["sum"])
+    tgt = jnp.ones((2, w), jnp.float32)
+    loss, (_, sgrads) = f({}, [table], [ids], tgt)
+    u = np.asarray(sgrads[0].ids)
+    assert (np.diff(u) >= 0).all(), u  # ascending incl. sentinel tail
+    # forward parity with the direct op-layer lookup (clip semantics)
+    direct = embedding_lookup(table, ids, combiner="sum")
+    np.testing.assert_allclose(
+        float(loss), float(jnp.sum(direct * tgt)), rtol=1e-6)
+    # applying the gradient changes only row 3 and (clip target) row 9
+    # must NOT be trained by the bad ids: grads for ids >= vocab drop
+    tx = sparse_rows_sgd(1.0)
+    st = tx.init([table])
+    upd, _ = tx.update(sgrads, st, [table])
+    [newt] = apply_sparse_updates([table], upd)
+    changed = np.where(
+        np.any(np.asarray(newt) != np.asarray(table), axis=1))[0]
+    np.testing.assert_array_equal(changed, [3])
+
+
+def test_step_memory_is_touched_rows_not_vocab():
+    """A big-table step's temporaries must be O(touched rows): a dense
+    gradient would add >= one table-size (16 MB here) of transients."""
+    vocab, w, b = 1_000_000, 4, 512
+    table = jnp.zeros((vocab, w), jnp.float32)
+    tx = sparse_rows_adagrad(0.1)
+
+    def loss_fn(dp, outs, y):
+        del dp
+        return jnp.mean((outs[0] - y) ** 2)
+
+    f = sparse_value_and_grad(loss_fn, combiners=["sum"])
+
+    def step(tbl, est, ids, y):
+        _, (_, sg) = f({}, [tbl], [ids], y)
+        upd, est = tx.update(sg, est, [tbl])
+        [tbl] = apply_sparse_updates([tbl], upd)
+        return tbl, est
+
+    ids = jnp.zeros((b, 2), jnp.int32)
+    y = jnp.zeros((b, w), jnp.float32)
+    est = tx.init([table])
+    compiled = (jax.jit(step, donate_argnums=(0, 1))
+                .lower(table, est, ids, y).compile())
+    mem = compiled.memory_analysis()
+    table_bytes = vocab * w * 4
+    # params + acc live in/out (donated); temporaries must stay far below
+    # one dense gradient
+    assert mem.temp_size_in_bytes < table_bytes // 4, (
+        mem.temp_size_in_bytes, table_bytes)
