@@ -42,10 +42,17 @@ class Ragged:
     This mirrors the (values, row_splits) encoding the reference feeds its
     variable-hotness kernel (``embedding_lookup_ops.py:79-80``), with the
     capacity made explicit so XLA sees a fixed shape.
+
+    ``weights`` (optional, ``[capacity]`` float): per-id multipliers — the
+    reference kernel's optional ``weights`` input
+    (``cc/kernels/embedding_lookup_kernels.cu:52-55``). With a ``'mean'``
+    combiner the weighted sum divides by the row's id COUNT (the kernel's
+    semantics, ``.cu:220-222``), not by the weight sum.
     """
 
     values: jax.Array  # [capacity] int
     row_splits: jax.Array  # [batch_size + 1] int
+    weights: Optional[jax.Array] = None  # [capacity] float
 
     @property
     def nrows(self) -> int:
@@ -56,8 +63,10 @@ class Ragged:
         return self.values.shape[0]
 
     @classmethod
-    def from_lists(cls, rows, capacity: Optional[int] = None, dtype=jnp.int32) -> "Ragged":
-        """Build from a python list of per-row id lists (test/data-pipeline helper)."""
+    def from_lists(cls, rows, capacity: Optional[int] = None, dtype=jnp.int32,
+                   weights=None) -> "Ragged":
+        """Build from a python list of per-row id lists (test/data-pipeline
+        helper); ``weights`` takes the same nested-list shape."""
         import numpy as np
 
         flat = [i for row in rows for i in row]
@@ -68,8 +77,17 @@ class Ragged:
             raise ValueError(f"total nnz {len(flat)} exceeds capacity {cap}")
         vals = np.zeros(cap, dtype=np.int64)
         vals[: len(flat)] = flat
+        warr = None
+        if weights is not None:
+            wflat = [w for row in weights for w in row]
+            if len(wflat) != len(flat):
+                raise ValueError("weights must mirror rows' nesting")
+            wbuf = np.zeros(cap, dtype=np.float32)
+            wbuf[: len(wflat)] = wflat
+            warr = jnp.asarray(wbuf)
         return cls(values=jnp.asarray(vals, dtype=dtype),
-                   row_splits=jnp.asarray(splits, dtype=dtype))
+                   row_splits=jnp.asarray(splits, dtype=dtype),
+                   weights=warr)
 
 
 @struct.dataclass
@@ -85,6 +103,7 @@ class SparseIds:
     indices: jax.Array  # [capacity, 2] int
     values: jax.Array  # [capacity] int
     dense_shape: Tuple[int, int] = struct.field(pytree_node=False)
+    weights: Optional[jax.Array] = None  # [capacity] float (see Ragged)
 
     @property
     def nrows(self) -> int:
@@ -191,9 +210,13 @@ def embedding_lookup(params: jax.Array, ids: IdsLike,
         return jnp.take(params, ids, axis=0, mode="clip")
 
     if isinstance(ids, Ragged):
+        if weights is None:
+            weights = ids.weights
         return _ragged_combine(params, ids.values, ids.row_splits, combiner, weights)
 
     if isinstance(ids, SparseIds):
+        if weights is None:
+            weights = ids.weights
         splits = row_to_split(ids.indices, ids.dense_shape[0], dtype=ids.values.dtype)
         return _ragged_combine(params, ids.values, splits, combiner, weights)
 

@@ -422,15 +422,53 @@ class DistributedEmbedding:
 
     # ----------------------------------------------------------------- forward
 
+    @staticmethod
+    def _dense_enc(shape, comb) -> tuple:
+        """Static routing descriptor of a dense input: ``("d", hotness,
+        num_slots)``. With a combiner the LAST dim is the reduced hotness
+        and every lead position beyond the batch becomes its own slot (the
+        reference flattens N-D inputs through its exchange and lets the
+        local layer reduce the trailing dim, ``dist_model_parallel.py:
+        273-288`` + ``embedding.py:115-132``); without one, every id is a
+        hotness-1 slot."""
+        dims = tuple(int(d) for d in shape[1:])
+        if comb:
+            h = dims[-1] if dims else 1
+            ns = int(np.prod(dims[:-1], dtype=np.int64)) if len(dims) > 1 \
+                else 1
+            return ("d", h, ns)
+        ns = int(np.prod(dims, dtype=np.int64)) if dims else 1
+        return ("d", 1, ns)
+
+    @staticmethod
+    def _enc_of_hot(h) -> tuple:
+        """MpInputs ``hots`` entry -> routing descriptor: an int is a 2-D
+        dense hotness; tuples pass through (``("r"|"rw", cap)`` ragged,
+        ``("d", hot, num_slots)`` N-D dense)."""
+        if isinstance(h, (tuple, list)):
+            k = h[0]
+            if k == "d":
+                return ("d", int(h[1]), int(h[2]) if len(h) > 2 else 1)
+            return (k, int(h[1]))
+        return ("d", int(h), 1)
+
+    @staticmethod
+    def _weight_bits(weights, cap: int, comm_dtype) -> jax.Array:
+        """Per-id float weights -> int payload that rides the id exchange
+        (bitcast f32->i32; widening to an int64 block preserves the bits)."""
+        w = jnp.asarray(weights).astype(jnp.float32).reshape(cap)
+        return lax.bitcast_convert_type(w, jnp.int32).astype(comm_dtype)
+
     def _normalize_inputs(self, inputs):
-        """Promote to a common int dtype; dense inputs become 2-D
-        ``[batch, hotness]``, :class:`~..ops.embedding_lookup.Ragged` inputs
-        become ``("r", values [cap], lengths [batch])`` records. Returns
-        ``(entries, encs, was_1d)`` where ``encs[i]`` is the static routing
-        descriptor (``("d", hotness)`` / ``("r", capacity)``, the key the
-        exchange plan is built from) and ``was_1d`` tracks 1-D dense
-        inputs so local lookups preserve the reference's ``[batch, width]``
-        output shape."""
+        """Promote to a common int dtype; dense inputs flatten to 2-D
+        ``[batch, -1]``, :class:`~..ops.embedding_lookup.Ragged` inputs
+        become ``("r"|"rw", values [cap], lengths [batch][, weight_bits])``
+        records. Returns ``(entries, encs, shapes)`` where ``encs[i]`` is
+        the static routing descriptor (``("d", hotness, num_slots)`` /
+        ``("r"|"rw", capacity)``, the key the exchange plan is built from)
+        and ``shapes[i]`` is the original dense shape (``None`` for
+        ragged) so single-worker lookups preserve the reference's local
+        output ranks."""
         if len(inputs) != self.strategy.num_inputs:
             raise ValueError(
                 f"Expected {self.strategy.num_inputs} inputs, got {len(inputs)}")
@@ -441,7 +479,8 @@ class DistributedEmbedding:
         inputs = [
             Ragged(values=inp.values,
                    row_splits=row_to_split(inp.indices, inp.dense_shape[0],
-                                           dtype=inp.values.dtype))
+                                           dtype=inp.values.dtype),
+                   weights=inp.weights)
             if isinstance(inp, SparseIds) else inp
             for inp in inputs]
         comm_dtype = jnp.int32
@@ -450,11 +489,12 @@ class DistributedEmbedding:
                     else (inp,))
             if any(jnp.asarray(a).dtype == jnp.int64 for a in arrs):
                 comm_dtype = jnp.int64
-        out, encs, was_1d = [], [], []
+        out, encs, shapes = [], [], []
         for i, inp in enumerate(inputs):
+            tid = self.strategy.input_table_map[i]
+            comb = self.strategy.global_configs[tid].get("combiner")
             if isinstance(inp, Ragged):
-                tid = self.strategy.input_table_map[i]
-                if not self.strategy.global_configs[tid].get("combiner"):
+                if not comb:
                     raise ValueError(
                         f"Ragged input {i} requires its table to have a "
                         "combiner (reference routes multi-hot ragged through "
@@ -462,16 +502,23 @@ class DistributedEmbedding:
                 values = jnp.asarray(inp.values).astype(comm_dtype)
                 splits = jnp.asarray(inp.row_splits)
                 lengths = (splits[1:] - splits[:-1]).astype(comm_dtype)
-                out.append(("r", values, lengths))
-                encs.append(("r", int(values.shape[0])))
-                was_1d.append(False)
+                cap = int(values.shape[0])
+                if inp.weights is not None:
+                    out.append(("rw", values, lengths,
+                                self._weight_bits(inp.weights, cap,
+                                                  comm_dtype)))
+                    encs.append(("rw", cap))
+                else:
+                    out.append(("r", values, lengths))
+                    encs.append(("r", cap))
+                shapes.append(None)
             else:
                 inp = jnp.asarray(inp).astype(comm_dtype)
-                was_1d.append(inp.ndim == 1)
-                inp = inp[:, None] if inp.ndim == 1 else inp
-                out.append(inp)
-                encs.append(("d", int(inp.shape[1])))
-        return out, encs, was_1d
+                shapes.append(tuple(inp.shape))
+                encs.append(self._dense_enc(inp.shape, comb))
+                out.append(inp.reshape(inp.shape[0], -1) if inp.ndim != 1
+                           else inp[:, None])
+        return out, encs, shapes
 
     @staticmethod
     def _csr_seg(lengths, cap: int):
@@ -578,22 +625,36 @@ class DistributedEmbedding:
                 "(the encoding of every input must be globally known)")
         encs = []
         for i, a in enumerate(arrs):
+            comb = self.strategy.global_configs[
+                self.strategy.input_table_map[i]].get("combiner")
             if hots is not None:
-                h = hots[i]
-                enc = (("r", int(h[1])) if isinstance(h, (tuple, list))
-                       else ("d", int(h)))
+                enc = self._enc_of_hot(hots[i])
             elif isinstance(a, Ragged):
-                enc = ("r", int(a.capacity))
+                enc = (("rw" if a.weights is not None else "r"),
+                       int(a.capacity))
             else:
-                enc = ("d", int(a.shape[1]))
+                enc = self._dense_enc(a.shape, comb)
             if a is not None:
-                if isinstance(a, Ragged) != (enc[0] == "r"):
+                if isinstance(a, Ragged) != (enc[0] in ("r", "rw")):
                     raise ValueError(
                         f"Input {i} encoding {enc} does not match the "
                         f"provided value type")
-                if enc[0] == "d" and a.shape[1] != enc[1]:
+                if isinstance(a, Ragged) and \
+                        (a.weights is not None) != (enc[0] == "rw"):
                     raise ValueError(
-                        f"Input {i} hotness {a.shape[1]} != hots[{i}]={enc[1]}")
+                        f"Input {i}: weighted ragged needs an ('rw', cap) "
+                        f"hots entry, got {enc}")
+                if enc[0] == "d":
+                    canon = self._dense_enc(a.shape, comb)
+                    # plan-equivalence, not tuple equality: without a
+                    # combiner ("d", h, ns) and ("d", 1, h*ns) build the
+                    # same hotness-1 slot layout (the legacy int-hots form)
+                    ok = (enc[1:] == canon[1:] if comb
+                          else enc[1] * enc[2] == canon[1] * canon[2])
+                    if not ok:
+                        raise ValueError(
+                            f"Input {i} shape {a.shape} does not match "
+                            f"hots[{i}]={hots[i] if hots else enc}")
             encs.append(enc)
 
         plan = self._get_plan(encs, b)
@@ -606,7 +667,7 @@ class DistributedEmbedding:
             g = plan.groups[inst.group]
             p0 = g.goff + inst.slot0 * g.blen
             span = inst.num_slots * g.blen
-            if g.kind == "r":
+            if g.kind in ("r", "rw"):
                 values = np.asarray(a.values)
                 splits = np.asarray(a.row_splits)
                 cap = g.hot
@@ -616,14 +677,21 @@ class DistributedEmbedding:
                         raise ValueError(
                             f"Input {inst.input_id}: shard {s} nnz {hi - lo} "
                             f"exceeds per-shard capacity {cap}")
-                    blk = np.zeros(cap + b, np_dtype)
+                    blk = np.zeros(g.blen, np_dtype)
                     blk[:hi - lo] = values[lo:hi]
-                    blk[cap:] = np.diff(splits[s * b:(s + 1) * b + 1])
+                    blk[cap:cap + b] = np.diff(splits[s * b:(s + 1) * b + 1])
+                    if g.kind == "rw":  # bitcast f32 weights into the block
+                        wb = np.zeros(cap, np.float32)
+                        wb[:hi - lo] = np.asarray(a.weights, np.float32
+                                                  )[lo:hi]
+                        blk[cap + b:] = wb.view(np.int32)
                     packed_np[inst.rank, s, p0:p0 + span] = blk
             else:
                 for s in range(world):
                     shard = a[s * b:(s + 1) * b]
-                    flat = (shard.T if inst.transposed else shard).reshape(-1)
+                    flat = (shard.reshape(b, inst.num_slots, g.hot)
+                            .transpose(1, 0, 2).reshape(-1)
+                            if inst.transposed else shard.reshape(-1))
                     packed_np[inst.rank, s, p0:p0 + span] = flat
         if mesh is not None:
             sharding = jax.sharding.NamedSharding(
@@ -634,7 +702,9 @@ class DistributedEmbedding:
                 packed_np.shape, sharding, lambda idx: packed_np[idx])
         else:
             packed = jnp.asarray(packed_np)
-        hots_out = tuple(h if k == "d" else ("r", h) for k, h in encs)
+        hots_out = tuple(
+            (enc[1] if enc[2] == 1 else enc) if enc[0] == "d" else enc
+            for enc in encs)
         return MpInputs(packed=packed, hots=hots_out, local_batch=b)
 
     def __call__(self, params: EmbedParams, inputs) -> List[jax.Array]:
@@ -671,7 +741,7 @@ class DistributedEmbedding:
                 raise ValueError(
                     "world_size == 1 takes a plain input list (mp and dp "
                     "input coincide)")
-            entries, encs, was_1d = self._normalize_inputs(inputs)
+            entries, encs, shapes = self._normalize_inputs(inputs)
             b = (entries[0][2].shape[0] if isinstance(entries[0], tuple)
                  else entries[0].shape[0])
             comm_dtype = (entries[0][1].dtype if isinstance(entries[0], tuple)
@@ -686,13 +756,18 @@ class DistributedEmbedding:
                 o = lax.slice(flat_out, (0, c0),
                               (b, c0 + inst.num_slots * g.width))
                 enc = encs[inst.input_id]
-                if (enc[0] == "d" and inst.num_slots > 1):
-                    o = o.reshape(b, inst.num_slots, g.width)
-                elif enc[0] == "d" and not was_1d[inst.input_id] and \
-                        self.strategy.global_configs[
-                            self.strategy.input_table_map[inst.input_id]
-                        ].get("combiner") is None:
-                    o = o.reshape(b, 1, g.width)  # 2-D 1-hot, no combiner
+                shape = shapes[inst.input_id]
+                # single-worker parity with the reference's local `call`
+                # (:493-500): dense outputs keep the input's rank —
+                # no combiner: shape[1:] + (w,); combiner: the lead dims
+                # survive the trailing-dim reduction
+                if enc[0] == "d" and shape is not None and len(shape) >= 2:
+                    comb = self.strategy.global_configs[
+                        self.strategy.input_table_map[inst.input_id]
+                    ].get("combiner")
+                    lead = shape[1:] if comb is None else shape[1:-1]
+                    if comb is None or lead:
+                        o = o.reshape((b,) + tuple(lead) + (g.width,))
                 outs.append(o)
             result = [outs[i] for i in self.strategy.rev_global_input_ids]
             return result, ("dist", ids_recv, tuple(encs), b)
@@ -731,8 +806,7 @@ class DistributedEmbedding:
                 raise ValueError(
                     f"Expected {self.strategy.num_inputs} hotness entries, "
                     f"got {len(inputs.hots)}")
-            encs = [(("r", int(h[1])) if isinstance(h, (tuple, list))
-                     else ("d", int(h))) for h in inputs.hots]
+            encs = [self._enc_of_hot(h) for h in inputs.hots]
             b = int(inputs.local_batch)
             plan = self._get_plan(encs, b)
             ids_recv = inputs.packed
@@ -857,17 +931,21 @@ class DistributedEmbedding:
 
     def _build_send_blocks(self, plan, entries, comm_dtype) -> jax.Array:
         """Assemble the dp->mp id blocks ``[world, l_max]`` in the plan's
-        group-region layout. Dead (padding) slots send zeros; a no-combiner
-        multi-hot feature sends its ids column-major so each of its hotness-1
-        slots stays contiguous."""
+        group-region layout. Dead (padding) slots send zeros; a multi-slot
+        feature (no-combiner multi-hot, or N-D dense) sends its ids
+        slot-major so each slot's ids stay contiguous."""
 
         def fill(inst):
             e = entries[inst.input_id]
-            if isinstance(e, tuple):  # ("r", values [cap], lengths [b])
-                return jnp.concatenate(
-                    [e[1].astype(comm_dtype), e[2].astype(comm_dtype)])
-            if inst.transposed:
-                return e.T.reshape(-1)  # spans num_slots cells
+            if isinstance(e, tuple):  # ("r"|"rw", values, lengths[, wbits])
+                parts = [e[1].astype(comm_dtype), e[2].astype(comm_dtype)]
+                if e[0] == "rw":
+                    parts.append(e[3].astype(comm_dtype))
+                return jnp.concatenate(parts)
+            if inst.transposed:  # slot-major: [b, ns*h] -> [ns, b, h] flat
+                h = plan.groups[inst.group].hot
+                return e.reshape(e.shape[0], inst.num_slots, h
+                                 ).transpose(1, 0, 2).reshape(-1)
             return e.reshape(-1)
 
         return self._assemble_cells(
@@ -887,7 +965,8 @@ class DistributedEmbedding:
         world = self.world_size
         r3 = region.reshape(world, g.n, g.blen)
         values = r3[:, :, :g.hot]
-        lengths = r3[:, :, g.hot:]
+        lengths = r3[:, :, g.hot:g.hot + b]  # "rw" blocks carry weight
+        # bits past the lengths (decoded by _region_weights)
         if valid is not None:
             lengths = lengths * valid[None, :, None].astype(r3.dtype)
         _, seg = self._csr_seg(lengths, g.hot)
@@ -897,6 +976,14 @@ class DistributedEmbedding:
                 + roff[None, :, None])
         counts = jnp.maximum(lengths, 1) if need_counts else None
         return values, lengths, seg, grow, counts
+
+    def _region_weights(self, g, b: int, region) -> jax.Array:
+        """Decode a weighted-ragged ("rw") region's per-id weights
+        ``[world, n, cap]`` from the bitcast payload past the lengths."""
+        world = self.world_size
+        r3 = region.reshape(world, g.n, g.blen)
+        bits = r3[:, :, g.hot + b:].astype(jnp.int32)
+        return lax.bitcast_convert_type(bits, jnp.float32)
 
     @staticmethod
     def _ragged_scatter_idx(g, b: int, world: int, seg) -> jax.Array:
@@ -966,6 +1053,12 @@ class DistributedEmbedding:
                     None if all_valid else self._plan_row(plan.valid[gi], my),
                     need_counts=any_mean, rbase=rbase)
                 gath = ps.packed_gather(slab, grow, g.width)  # [w, n, cap, ww]
+                if g.kind == "rw":
+                    # per-id weights multiply the gathered rows (reference
+                    # kernel's optional weights, .cu:52-55); mean still
+                    # divides by the id count (.cu:220-222)
+                    wts = self._region_weights(g, b, region)
+                    gath = gath * wts[..., None].astype(gath.dtype)
                 if use_mask:
                     loc = (values - rbase[None, :, None]
                            if rbase is not None else values)
@@ -1186,6 +1279,12 @@ class DistributedEmbedding:
                     axis=2)  # [world, n, b+1, w]
                 vals = jnp.take(gpad.reshape(-1, g.width), sidx.reshape(-1),
                                 axis=0).reshape(world, g.n, g.hot, g.width)
+                if g.kind == "rw":
+                    # d(w_i * x_i)/dx_i: the weight multiplies the per-id
+                    # cotangent (the reference backward reuses the forward
+                    # kernel with the same weights input, .cu:539-627)
+                    wts = self._region_weights(g, b, region)
+                    vals = vals * wts[..., None].astype(vals.dtype)
                 if any_mean:
                     cpad = jnp.concatenate(
                         [counts, jnp.ones((world, g.n, 1), counts.dtype)],
@@ -1423,13 +1522,19 @@ class DistributedEmbedding:
         ``dist_model_parallel.py:337-339``).
 
         ``use_lock=True`` serializes the host-side shard building across
-        processes *on the same machine* with a file lock — the reference's
-        ``set_weights(..., use_lock=True)`` (``dist_model_parallel.py:331``),
-        for loading models whose per-process transient host footprint could
-        not otherwise coexist. The streaming chunked design mostly obviates
-        it (peak transient host memory is one chunk), but page-cache
-        pressure from several processes mmap-reading the same checkpoint
-        can still merit serialization.
+        processes — the reference's ``set_weights(..., use_lock=True)``,
+        which rank-serializes globally via ``broadcast_object``
+        (``dist_model_parallel.py:329-331,383-385``), for loading models
+        whose per-process transient host footprint could not otherwise
+        coexist. Two layers: co-located processes serialize on a per-uid
+        file lock, and on a multi-process ``jax.distributed`` job the
+        processes additionally take strict turns (process 0 first), gated
+        by a cross-host barrier after each turn — full cross-rank
+        serialization like the reference, machine boundaries included.
+        The streaming chunked design mostly obviates the need (peak
+        transient host memory is one chunk), but page-cache pressure from
+        several processes mmap-reading the same checkpoint can still merit
+        it.
 
         Streams per-slice row chunks directly into per-device shard buffers
         — the reference's 128M-element chunked ``scatter_update``
@@ -1466,12 +1571,7 @@ class DistributedEmbedding:
                 raise ValueError(
                     f"Table {tid}: expected shape {want}, got {src.shape}")
 
-        lock_file = None
-        if use_lock:
-            import fcntl
-            lock_file = open(self._uid_lock_path(), "w")
-            fcntl.flock(lock_file, fcntl.LOCK_EX)
-        try:
+        def build():
             out = {}
             for w in self.widths:
                 if mesh is None:
@@ -1489,9 +1589,41 @@ class DistributedEmbedding:
                     mesh, w,
                     lambda dev, r0, r1, w=w: self._build_shard(
                         loaded, dev, w, r0, r1, dtype, chunk_elems))
-        finally:
-            if lock_file is not None:
-                import fcntl
+            return out
+
+        if not use_lock:
+            return build()
+
+        import fcntl
+
+        def locked_build():
+            # the file lock wraps ONLY this process's own build turn: held
+            # across a barrier wait it would deadlock two co-located
+            # processes of one job (A holds the lock waiting for B's
+            # barrier; B waits on the lock)
+            lock_file = open(self._uid_lock_path(), "w")
+            fcntl.flock(lock_file, fcntl.LOCK_EX)
+            try:
+                return build()
+            finally:
                 fcntl.flock(lock_file, fcntl.LOCK_UN)
                 lock_file.close()
-        return out
+
+        if jax.process_count() > 1:
+            # strict process turns with a cross-host barrier after each —
+            # the reference's broadcast_object rank serialization
+            # (dist_model_parallel.py:329-331,383-385) across machine
+            # boundaries, where a file lock cannot reach. Every process
+            # joins every barrier (collective), sandwiching its own build
+            # at its process index.
+            from jax.experimental import multihost_utils
+            me = jax.process_index()
+            for p in range(me):
+                multihost_utils.sync_global_devices(
+                    f"detpu_set_weights_turn_{p}")
+            out = locked_build()
+            for p in range(me, jax.process_count()):
+                multihost_utils.sync_global_devices(
+                    f"detpu_set_weights_turn_{p}")
+            return out
+        return locked_build()

@@ -48,7 +48,7 @@ import numpy as np
 class GroupSpec:
     """One rank-uniform region of the exchange layout."""
 
-    kind: str    # "d" dense | "r" ragged
+    kind: str    # "d" dense | "r" ragged | "rw" ragged with per-id weights
     width: int   # per-slot output width (the column-slice width for slices)
     hot: int     # dense: ids per batch row per slot; ragged: value capacity
     n: int       # slots (max over ranks; shorter ranks are padded)
@@ -61,8 +61,11 @@ class GroupSpec:
 class InstanceSpec:
     """One routed input on one rank (worker-order entry).
 
-    ``num_slots > 1`` only for no-combiner multi-hot features (one slot per
-    hot position, ids sent column-major)."""
+    ``num_slots > 1`` for no-combiner multi-hot features (one slot per hot
+    position, ids sent column-major) and for N-D dense combiner inputs
+    (``[b, d1, ..., h]``: one hotness-``h`` slot per lead position — the
+    reference flattens such inputs through its exchange the same way,
+    ``dist_model_parallel.py:273-288``)."""
 
     input_id: int
     rank: int
@@ -119,7 +122,10 @@ def build_plan(strategy, row_offsets_list: Sequence[Sequence[int]],
     Args:
       strategy: a planned :class:`~.strategy.DistEmbeddingStrategy`.
       row_offsets_list: per-rank per-local-table logical slab row offsets.
-      encs: per global input, ``("d", hotness)`` or ``("r", capacity)``.
+      encs: per global input: dense ``("d", hotness[, num_slots])`` (the
+        third element — N-D lead positions — defaults to 1) or ragged
+        ``("r", capacity)`` / ``("rw", capacity)`` (per-id weights ride
+        the block as bitcast floats past the lengths).
       b: per-shard batch size.
     """
     world = strategy.world_size
@@ -138,16 +144,20 @@ def build_plan(strategy, row_offsets_list: Sequence[Sequence[int]],
             comb = cfg.get("combiner")
             rbase = int(cfg.get("_row_base", 0))
             rsl = 1.0 if "_row_base" in cfg else 0.0
-            kind, param = encs[i]
+            enc = encs[i]
+            kind, param = enc[0], int(enc[1])
+            nslots = int(enc[2]) if len(enc) > 2 else 1
             if kind == "d":
                 if comb:
-                    key = ("d", w, int(param))
+                    # N-D inputs: one hotness-`param` slot per lead position
+                    key = ("d", w, param)
                     entries = [(rows, roff, 1.0,
-                                1.0 if comb == "mean" else 0.0, rbase, rsl)]
+                                1.0 if comb == "mean" else 0.0, rbase, rsl)
+                               ] * nslots
                 else:
                     key = ("d", w, 1)
                     entries = [(rows, roff, 1.0, 0.0, rbase, rsl)
-                               ] * int(param)
+                               ] * (param * nslots)
             else:
                 if comb is None:
                     # without this, a combiner-less table would silently get
@@ -156,7 +166,9 @@ def build_plan(strategy, row_offsets_list: Sequence[Sequence[int]],
                         f"Input {i} is Ragged but table "
                         f"{strategy.input_table_map[i]} has no combiner; "
                         "ragged features require combiner='sum' or 'mean'")
-                key = ("r", w, int(param))
+                key = (kind, w, param)  # "r" | "rw" (per-id weights ride
+                # the block as bitcast floats, so weighted features group
+                # separately — their slots are one capacity longer)
                 entries = [(rows, roff, 1.0,
                             1.0 if comb == "mean" else 0.0, rbase, rsl)]
             slots = key_slots.setdefault(key, [[] for _ in range(world)])
@@ -173,7 +185,7 @@ def build_plan(strategy, row_offsets_list: Sequence[Sequence[int]],
         slots = key_slots[k]
         kind, w, hp = k
         n = max(len(s) for s in slots)
-        blen = b * hp if kind == "d" else hp + b
+        blen = {"d": b * hp, "r": hp + b, "rw": 2 * hp + b}[kind]
         groups.append(GroupSpec(kind, w, hp, n, blen, goff, col))
         goff += n * blen
         col += n * w

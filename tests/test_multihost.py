@@ -95,8 +95,16 @@ for _ in range(3):
 
 tables = de.get_weights(state.emb_params, chunk_elems=256)
 digest = [float(np.asarray(t, np.float64).sum()) for t in tables]
+
+# cross-process serialized reload (reference use_lock broadcast_object
+# parity): every process takes a barrier-gated turn; must neither deadlock
+# nor corrupt the values
+params2 = de.set_weights(tables, mesh=mesh, use_lock=True, chunk_elems=256)
+tables2 = de.get_weights(params2, chunk_elems=256)
+digest2 = [float(np.asarray(t, np.float64).sum()) for t in tables2]
 print("RESULT " + json.dumps({
-    "pid": pid, "loss": float(loss), "digest": digest}))
+    "pid": pid, "loss": float(loss), "digest": digest,
+    "digest2": digest2}))
 """
 
 
@@ -128,6 +136,9 @@ def test_two_process_train_and_checkpoint():
     # both processes agree on loss and on the reassembled tables
     assert res[0]["loss"] == pytest.approx(res[1]["loss"], rel=1e-6)
     np.testing.assert_allclose(res[0]["digest"], res[1]["digest"], rtol=1e-6)
+    # the lock-serialized reload round-trips on both processes
+    for r in res:
+        np.testing.assert_allclose(r["digest2"], r["digest"], rtol=1e-6)
 
     # and the 2-process run matches a single-process oracle bit-for-bit
     # (same seeds, same global batch, same mesh size)

@@ -1,0 +1,377 @@
+"""Round-5 parity edges (VERDICT r4 Missing #3-#5).
+
+* N-D (>2-D) dense inputs through the distributed path — the reference
+  flattens arbitrary dense inputs through its exchange and lets the local
+  layer reduce the trailing dim (``dist_model_parallel.py:273-288``);
+  distributed outputs are ``[batch, -1]`` flats, single-worker outputs
+  keep the local layer's rank.
+* Per-id weights plumbed through ``layers.Embedding`` and the distributed
+  executor (the CUDA kernel's optional ``weights`` input,
+  ``embedding_lookup_kernels.cu:52-55``): weighted ``Ragged``/``SparseIds``
+  features ride the id exchange as bitcast payloads; ``'mean'`` divides by
+  the id count (kernel semantics, ``.cu:220-222``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_embeddings_tpu.layers import Embedding
+from distributed_embeddings_tpu.ops import (Ragged, SparseIds,
+                                            embedding_lookup)
+from distributed_embeddings_tpu.parallel import (
+    DistributedEmbedding, SparseSGD, init_hybrid_state,
+    make_hybrid_train_step)
+
+WORLD = 8
+B = 2  # local batch
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = jax.devices()
+    assert len(devs) >= WORLD
+    return Mesh(np.array(devs[:WORLD]), ("data",))
+
+
+def _dist_forward(de, mesh, flat, inputs):
+    def fwd(params, inps):
+        return tuple(de(params, list(inps)))
+
+    return jax.jit(jax.shard_map(
+        fwd, mesh=mesh, in_specs=(P("data"), P("data")),
+        out_specs=P("data")))(flat, tuple(inputs))
+
+
+# --------------------------------------------------------------- N-D dense
+
+
+_ND_SHAPE_TAILS = [(3, 2), (2, 2, 3), (2, 3), (4,)] * 2
+
+
+def _nd_model():
+    base = [
+        {"input_dim": 37, "output_dim": 8, "combiner": "sum"},   # 3-D
+        {"input_dim": 23, "output_dim": 4, "combiner": "mean"},  # 4-D
+        {"input_dim": 51, "output_dim": 8, "combiner": None},    # 3-D
+        {"input_dim": 40, "output_dim": 4, "combiner": "sum"},   # 2-D
+    ]
+    return [dict(c, input_dim=c["input_dim"] + 10 * (k // 4))
+            for k, c in enumerate(base * 2)]  # >= WORLD tables
+
+
+def _nd_inputs(rng, configs, batch=WORLD * B):
+    return [jnp.asarray(
+        rng.integers(0, c["input_dim"], size=(batch,) + tail), jnp.int32)
+        for c, tail in zip(configs, _ND_SHAPE_TAILS)]
+
+
+def _nd_oracle(tables, configs, inputs):
+    """Reference distributed layout: combiner reduces the LAST dim, output
+    flattens to [batch, -1] (``dist_model_parallel.py:288,308``)."""
+    outs = []
+    for t, cfg, ids in zip(tables, configs, inputs):
+        t = jnp.asarray(t)
+        if cfg["combiner"]:
+            flat = ids.reshape(-1, ids.shape[-1])
+            o = embedding_lookup(t, flat, combiner=cfg["combiner"])
+        else:
+            o = embedding_lookup(t, ids)
+        outs.append(o.reshape(ids.shape[0], -1))
+    return outs
+
+
+@pytest.mark.parametrize("strategy", ["basic", "memory_balanced"])
+def test_nd_dense_distributed_forward(mesh, strategy):
+    rng = np.random.default_rng(7)
+    configs = _nd_model()
+    de = DistributedEmbedding(configs, world_size=WORLD, strategy=strategy)
+    flat = de.init(jax.random.key(0), mesh=mesh)
+    tables = de.get_weights(flat)
+    inputs = _nd_inputs(rng, configs)
+    expect = _nd_oracle(tables, configs, inputs)
+    outs = _dist_forward(de, mesh, flat, inputs)
+    for i, (a, b) in enumerate(zip(outs, expect)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6, err_msg=f"out {i}")
+
+
+def test_nd_dense_mp_input_forward(mesh):
+    rng = np.random.default_rng(8)
+    configs = _nd_model()
+    de = DistributedEmbedding(configs, world_size=WORLD, dp_input=False)
+    flat = de.init(jax.random.key(0), mesh=mesh)
+    tables = de.get_weights(flat)
+    inputs = _nd_inputs(rng, configs)
+    packed = de.pack_mp_inputs([np.asarray(x) for x in inputs], mesh=mesh)
+    expect = _nd_oracle(tables, configs, inputs)
+
+    def fwd(params, mp):
+        return tuple(de(params, mp))
+
+    outs = jax.jit(jax.shard_map(
+        fwd, mesh=mesh, in_specs=(P("data"), P("data")),
+        out_specs=P("data")))(flat, packed)
+    for i, (a, b) in enumerate(zip(outs, expect)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6, err_msg=f"out {i}")
+
+
+def test_nd_dense_single_worker_keeps_rank():
+    """world_size == 1 matches the reference's local `call`: N-D outputs
+    keep their rank (combiner drops the last dim, no-combiner appends w)."""
+    rng = np.random.default_rng(9)
+    configs = _nd_model()
+    de = DistributedEmbedding(configs, world_size=1)
+    params = de.init(jax.random.key(0))
+    tables = de.get_weights(params)
+    inputs = _nd_inputs(rng, configs, batch=4)
+    outs = de(params, inputs)
+    assert outs[0].shape == (4, 3, 8)        # sum over last dim
+    assert outs[1].shape == (4, 2, 2, 4)     # mean over last dim
+    assert outs[2].shape == (4, 2, 3, 8)     # no combiner: + width dim
+    assert outs[3].shape == (4, 4)           # 2-D + combiner
+    for t, cfg, ids, o in zip(tables, configs, inputs, outs):
+        t = jnp.asarray(t)
+        if cfg["combiner"]:
+            ref = embedding_lookup(
+                t, ids.reshape(-1, ids.shape[-1]), combiner=cfg["combiner"]
+            ).reshape(o.shape)
+        else:
+            ref = embedding_lookup(t, ids)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_nd_dense_train_step_matches_oracle(mesh):
+    """One hybrid SGD step with a 3-D input equals the full-batch oracle
+    update (the reference suite's updated-weights comparison pattern)."""
+    rng = np.random.default_rng(10)
+    configs = _nd_model()
+    de = DistributedEmbedding(configs, world_size=WORLD)
+    lr = 0.3
+    emb_opt = SparseSGD()
+    import optax
+    dp0 = {"s": jnp.float32(1.0)}
+    tx = optax.sgd(lr)
+
+    def loss_fn(dp, outs, y):
+        x = jnp.concatenate([o.reshape(o.shape[0], -1) for o in outs], 1)
+        return jnp.mean(x * dp["s"]) + 0.0 * y.sum()
+
+    state = init_hybrid_state(de, emb_opt, jax.tree.map(jnp.copy, dp0),
+                              tx, jax.random.key(0), mesh=mesh)
+    tables0 = [jnp.asarray(t) for t in de.get_weights(state.emb_params)]
+    step = make_hybrid_train_step(de, loss_fn, tx, emb_opt, mesh=mesh,
+                                  lr_schedule=lr)
+    inputs = _nd_inputs(rng, configs)
+    y = jax.device_put(jnp.zeros((WORLD * B, 1), jnp.float32),
+                       jax.sharding.NamedSharding(mesh, P("data")))
+    _, state = step(state, inputs, y)
+    got = de.get_weights(state.emb_params)
+
+    def oracle_loss(tabs):
+        outs = _nd_oracle(tabs, configs, inputs)
+        return loss_fn(dp0, outs, np.zeros(1))
+
+    grads = jax.grad(oracle_loss)(tables0)
+    for i, (t0, g, gt) in enumerate(zip(tables0, grads, got)):
+        np.testing.assert_allclose(np.asarray(t0 - lr * g), np.asarray(gt),
+                                   rtol=1e-5, atol=1e-6, err_msg=f"table {i}")
+
+
+# ---------------------------------------------------------- per-id weights
+
+
+def test_op_layer_and_module_weights():
+    rng = np.random.default_rng(11)
+    vocab, w, b = 19, 4, 6
+    table = jnp.asarray(rng.normal(size=(vocab, w)), jnp.float32)
+    rows = [list(rng.integers(0, vocab, size=rng.integers(1, 4)))
+            for _ in range(b)]
+    wts = [list(rng.random(len(r)).astype(np.float32)) for r in rows]
+    rag = Ragged.from_lists(rows, capacity=16, weights=wts)
+
+    # manual oracle: weighted sum / count for mean (kernel semantics)
+    def oracle(comb):
+        out = np.zeros((b, w), np.float32)
+        for i, (r, ws) in enumerate(zip(rows, wts)):
+            for rid, wt in zip(r, ws):
+                out[i] += wt * np.asarray(table)[rid]
+            if comb == "mean" and r:
+                out[i] /= len(r)
+        return out
+
+    for comb in ("sum", "mean"):
+        got = embedding_lookup(table, rag, combiner=comb)
+        np.testing.assert_allclose(np.asarray(got), oracle(comb),
+                                   rtol=1e-5, atol=1e-6)
+        # the Flax layer plumbs the same weights (field or argument)
+        mod = Embedding(input_dim=vocab, output_dim=w, combiner=comb)
+        out2 = mod.apply({"params": {"embeddings": table}}, rag)
+        np.testing.assert_allclose(np.asarray(out2), oracle(comb),
+                                   rtol=1e-5, atol=1e-6)
+    # dense 2-D ids + explicit weights argument
+    ids = jnp.asarray(rng.integers(0, vocab, size=(b, 3)), jnp.int32)
+    dw = jnp.asarray(rng.random((b, 3)), jnp.float32)
+    mod = Embedding(input_dim=vocab, output_dim=w, combiner="sum")
+    got = mod.apply({"params": {"embeddings": table}}, ids, weights=dw)
+    ref = jnp.einsum("bh,bhw->bw", dw, jnp.take(table, ids, axis=0))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def _weighted_model(rng, num_tables=8):
+    configs, feats = [], []
+    cap = B * 4
+    for i in range(num_tables):
+        width = int(rng.integers(1, 9))
+        rows = int(rng.integers(6, 60))
+        comb = "mean" if i % 2 else "sum"
+        configs.append({"input_dim": rows, "output_dim": width,
+                        "combiner": comb})
+    return configs, cap
+
+
+def _weighted_inputs(rng, configs, cap):
+    """Global weighted-ragged inputs as stacked per-shard CSR blocks."""
+    inputs, per_shard = [], []
+    for cfg in configs:
+        vals, splits, wts, shards = [], [], [], []
+        for s in range(WORLD):
+            rows = [list(rng.integers(0, cfg["input_dim"],
+                                      size=int(rng.integers(0, 5))))
+                    for _ in range(B)]
+            ws = [list(rng.random(len(r)).astype(np.float32)) for r in rows]
+            r = Ragged.from_lists(rows, capacity=cap, weights=ws)
+            vals.append(r.values)
+            splits.append(r.row_splits)
+            wts.append(r.weights)
+            shards.append((rows, ws))
+        inputs.append(Ragged(values=jnp.concatenate(vals),
+                             row_splits=jnp.concatenate(splits),
+                             weights=jnp.concatenate(wts)))
+        per_shard.append(shards)
+    return inputs, per_shard
+
+
+def _weighted_oracle(tables, configs, per_shard, cap):
+    outs = []
+    for t, cfg, shards in zip(tables, configs, per_shard):
+        t = jnp.asarray(t)
+        parts = [embedding_lookup(
+            t, Ragged.from_lists(rows, capacity=cap, weights=ws),
+            combiner=cfg["combiner"]) for rows, ws in shards]
+        outs.append(jnp.concatenate(parts, axis=0))
+    return outs
+
+
+def test_weighted_ragged_distributed_forward(mesh):
+    rng = np.random.default_rng(12)
+    configs, cap = _weighted_model(rng)
+    de = DistributedEmbedding(configs, world_size=WORLD,
+                              strategy="memory_balanced")
+    flat = de.init(jax.random.key(1), mesh=mesh)
+    tables = de.get_weights(flat)
+    inputs, per_shard = _weighted_inputs(rng, configs, cap)
+    expect = _weighted_oracle(tables, configs, per_shard, cap)
+    outs = _dist_forward(de, mesh, flat, inputs)
+    for i, (a, b) in enumerate(zip(outs, expect)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6, err_msg=f"out {i}")
+
+
+def test_weighted_ragged_mp_input_forward(mesh):
+    rng = np.random.default_rng(13)
+    configs, cap = _weighted_model(rng, num_tables=8)
+    de = DistributedEmbedding(configs, world_size=WORLD, dp_input=False)
+    flat = de.init(jax.random.key(1), mesh=mesh)
+    tables = de.get_weights(flat)
+
+    # one GLOBAL-batch ragged per feature (pack_mp_inputs contract)
+    inputs, glob_rows = [], []
+    for cfg in configs:
+        rows = [list(rng.integers(0, cfg["input_dim"],
+                                  size=int(rng.integers(0, 5))))
+                for _ in range(WORLD * B)]
+        ws = [list(rng.random(len(r)).astype(np.float32)) for r in rows]
+        inputs.append(Ragged.from_lists(rows, capacity=WORLD * B * 4,
+                                        weights=ws))
+        glob_rows.append((rows, ws))
+    packed = de.pack_mp_inputs(inputs, mesh=mesh,
+                               hots=[("rw", cap)] * len(configs))
+
+    def fwd(params, mp):
+        return tuple(de(params, mp))
+
+    outs = jax.jit(jax.shard_map(
+        fwd, mesh=mesh, in_specs=(P("data"), P("data")),
+        out_specs=P("data")))(flat, packed)
+    for i, cfg in enumerate(configs):
+        rows, ws = glob_rows[i]
+        ref = embedding_lookup(
+            jnp.asarray(tables[i]),
+            Ragged.from_lists(rows, capacity=WORLD * B * 8, weights=ws),
+            combiner=cfg["combiner"])
+        np.testing.assert_allclose(np.asarray(outs[i]), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6, err_msg=f"out {i}")
+
+
+def test_weighted_ragged_train_step_matches_oracle(mesh):
+    """Weights scale the backward too: one hybrid SGD step equals the
+    oracle update through the weighted op-layer autodiff."""
+    rng = np.random.default_rng(14)
+    configs, cap = _weighted_model(rng, num_tables=8)
+    de = DistributedEmbedding(configs, world_size=WORLD)
+    lr = 0.25
+    import optax
+    emb_opt = SparseSGD()
+    dp0 = {"s": jnp.float32(1.0)}
+    tx = optax.sgd(lr)
+
+    def loss_fn(dp, outs, y):
+        x = jnp.concatenate([o.reshape(o.shape[0], -1) for o in outs], 1)
+        return jnp.mean(x * dp["s"]) + 0.0 * y.sum()
+
+    state = init_hybrid_state(de, emb_opt, jax.tree.map(jnp.copy, dp0),
+                              tx, jax.random.key(2), mesh=mesh)
+    tables0 = [jnp.asarray(t) for t in de.get_weights(state.emb_params)]
+    step = make_hybrid_train_step(de, loss_fn, tx, emb_opt, mesh=mesh,
+                                  lr_schedule=lr)
+    inputs, per_shard = _weighted_inputs(rng, configs, cap)
+    y = jax.device_put(jnp.zeros((WORLD * B, 1), jnp.float32),
+                       jax.sharding.NamedSharding(mesh, P("data")))
+    _, state = step(state, inputs, y)
+    got = de.get_weights(state.emb_params)
+
+    def oracle_loss(tabs):
+        outs = _weighted_oracle(tabs, configs, per_shard, cap)
+        return loss_fn(dp0, outs, np.zeros(1))
+
+    grads = jax.grad(oracle_loss)(tables0)
+    for i, (t0, g, gt) in enumerate(zip(tables0, grads, got)):
+        np.testing.assert_allclose(np.asarray(t0 - lr * g), np.asarray(gt),
+                                   rtol=1e-5, atol=1e-6, err_msg=f"table {i}")
+
+
+def test_weighted_sparse_ids_roundtrip():
+    """SparseIds carries weights through the COO->CSR conversion (op layer
+    and executor entry)."""
+    rng = np.random.default_rng(15)
+    vocab, w, b = 21, 4, 5
+    table = jnp.asarray(rng.normal(size=(vocab, w)), jnp.float32)
+    rows = np.sort(rng.integers(0, b, size=9))
+    coo = SparseIds(
+        indices=jnp.asarray(np.stack([rows, np.arange(9) % 3], 1),
+                            jnp.int32),
+        values=jnp.asarray(rng.integers(0, vocab, size=9), jnp.int32),
+        dense_shape=(b, 3),
+        weights=jnp.asarray(rng.random(9), jnp.float32))
+    got = embedding_lookup(table, coo, combiner="sum")
+    ref = np.zeros((b, w), np.float32)
+    for r, v, wt in zip(rows, np.asarray(coo.values),
+                        np.asarray(coo.weights)):
+        ref[r] += wt * np.asarray(table)[v]
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5, atol=1e-6)
