@@ -2,6 +2,7 @@
 synthetic benchmark models)."""
 
 from .dlrm import DLRM, DLRMConfig, dlrm_initializer, dot_interact
+from .learnable import LearnableClicks, train_dlrm_convergence
 from .schedules import warmup_poly_decay_schedule
 from .synthetic import (
     InputGenerator,
