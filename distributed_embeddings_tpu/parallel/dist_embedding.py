@@ -78,6 +78,7 @@ EmbedParams = Dict[str, jax.Array]
 CHECKPOINT_CHUNK_ELEMS = 128 * 1024 * 1024
 
 
+
 @functools.partial(jax.jit, donate_argnums=0)
 def _write_rows(buf: jax.Array, chunk: jax.Array, start) -> jax.Array:
     """Donated row-range write into a shard buffer (in-place on backends with
@@ -539,7 +540,8 @@ class DistributedEmbedding:
 
     def pack_mp_inputs(self, inputs, dtype=None, mesh=None,
                        hots: Optional[Sequence[Any]] = None,
-                       local_batch: Optional[int] = None) -> MpInputs:
+                       local_batch: Optional[int] = None,
+                       as_numpy: bool = False) -> MpInputs:
         """Pack per-feature global-batch ids into :class:`MpInputs`.
 
         ``inputs[i]`` is ``[global_batch]`` / ``[global_batch, hotness]``
@@ -569,7 +571,13 @@ class DistributedEmbedding:
         Args:
           dtype: id dtype of the packed block; default promotes like the dp
             path (int64 if any provided array is int64, else int32).
+          as_numpy: return the packed block as host numpy (no device
+            conversion) — for pipeline benchmarking/staging where the
+            caller owns placement. Mutually exclusive with ``mesh``.
         """
+        if as_numpy and mesh is not None:
+            raise ValueError("as_numpy=True returns a host array; it "
+                             "cannot also be laid out on a mesh")
         world = self.world_size
         arrs = []
         for x in inputs:
@@ -687,13 +695,20 @@ class DistributedEmbedding:
                         blk[cap + b:] = wb.view(np.int32)
                     packed_np[inst.rank, s, p0:p0 + span] = blk
             else:
-                for s in range(world):
-                    shard = a[s * b:(s + 1) * b]
-                    flat = (shard.reshape(b, inst.num_slots, g.hot)
-                            .transpose(1, 0, 2).reshape(-1)
-                            if inst.transposed else shard.reshape(-1))
-                    packed_np[inst.rank, s, p0:p0 + span] = flat
-        if mesh is not None:
+                # one vectorized slice-assign for all shards (a per-shard
+                # python loop measured 10.5 ms/batch at the v5e-16 bench
+                # shapes; this form is one numpy memcpy per feature)
+                if inst.transposed:  # slot-major within each shard block
+                    flat = (a.reshape(world, b, inst.num_slots, g.hot)
+                            .transpose(0, 2, 1, 3).reshape(world, -1))
+                else:
+                    flat = a.reshape(world, -1)
+                packed_np[inst.rank, :, p0:p0 + span] = flat
+        if as_numpy:
+            # host-side packing only (pipeline benchmarking / staging):
+            # the caller owns the device placement
+            packed = packed_np
+        elif mesh is not None:
             sharding = jax.sharding.NamedSharding(
                 mesh, jax.sharding.PartitionSpec(self.axis_name))
             # callback-per-shard works on multi-host meshes too: each process

@@ -62,6 +62,12 @@ from ..ops.sparse_grad import dedup_sparse_grad
 #   * small streams into huge slabs (65k rows / 10.2 GB bf16, the
 #     Criteo-1TB shard): sorted is 3x WORSE (54 vs 19 ms) — the unsorted
 #     lowering is slab-copy-bound there and the sorted one is worse still.
+# r5 re-test: ISOLATED scan-chained probes at the two loss shapes showed
+# sorted winning (86.4 -> 70.2 ms / 154.0 -> 130.7 ms), but lifting the 2M
+# cap regressed the END-TO-END benches (tiny-zoo bf16 Adagrad 167 -> 195
+# ms; multihot unchanged) — in the full step the scatter fuses/schedules
+# differently than in isolation. The window is an end-to-end fact; always
+# re-validate candidate changes on the bench variants, not probes alone.
 _SORT_STREAM_MIN = 256_000
 _SORT_STREAM_MAX = 2_000_000
 

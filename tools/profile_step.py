@@ -29,6 +29,9 @@ def main():
     variant = sys.argv[1] if len(sys.argv) > 1 else "ragged"
     batch = int(sys.argv[2]) if len(sys.argv) > 2 else (
         16384 if variant == "ragged" else BATCH)
+    # optional 3rd arg: parameter dtype (the bench headline is bf16 params)
+    param_dtype = (jnp.bfloat16 if len(sys.argv) > 3
+                   and sys.argv[3] == "bf16" else jnp.float32)
     table_sizes = [min(s, CAP) for s in CRITEO_KAGGLE_SIZES]
     cfg = make_cfg(table_sizes, jnp.bfloat16)
     combiner = "sum" if variant == "ragged" else None
@@ -59,7 +62,7 @@ def main():
                 for s in table_sizes]
 
     state, num, labels = build_state(de, dense, cfg, emb_opt, tx,
-                                     table_sizes, jnp.float32, batch=batch)
+                                     table_sizes, param_dtype, batch=batch)
 
     def loss_fn(dp, emb_outs, batch_):
         n, y = batch_
