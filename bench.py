@@ -443,18 +443,27 @@ def run_input_pipeline(world=16, batches=6):
     the projected step rate or the input side caps the projection; the
     reference's analogous path is its per-rank dataset slicing,
     ``examples/dlrm/main.py:166-190``)."""
-    import os
+    import shutil
     import tempfile
+
+    rng = np.random.default_rng(0)
+    n = BATCH * batches
+    root = tempfile.mkdtemp(prefix="detpu_bench_ds_")
+    try:
+        return _input_pipeline_body(root, rng, n, world)
+    finally:
+        # _guard retries on failure: leaking a ~25 MB /tmp dataset per
+        # failed attempt would accumulate across bench runs
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _input_pipeline_body(root, rng, n, world):
+    import os
 
     from distributed_embeddings_tpu.utils import RawBinaryDataset
     from distributed_embeddings_tpu.utils.data import (
         get_categorical_feature_type)
 
-    import shutil
-
-    rng = np.random.default_rng(0)
-    n = BATCH * batches
-    root = tempfile.mkdtemp(prefix="detpu_bench_ds_")
     d = os.path.join(root, "train")
     os.makedirs(d, exist_ok=True)
     (rng.random(n) < 0.5).astype(np.bool_).tofile(
@@ -489,15 +498,10 @@ def run_input_pipeline(world=16, batches=6):
             tot += num.shape[0]
         return tot, blk_bytes
 
-    try:
-        one_pass()  # warm the page cache
-        t0 = time.perf_counter()
-        tot, blk_bytes = one_pass()
-        dt = time.perf_counter() - t0
-    finally:
-        # _guard retries on failure: leaking a ~25 MB /tmp dataset per
-        # failed attempt would accumulate across bench runs
-        shutil.rmtree(root, ignore_errors=True)
+    one_pass()  # warm the page cache
+    t0 = time.perf_counter()
+    tot, blk_bytes = one_pass()
+    dt = time.perf_counter() - t0
     return tot / dt, blk_bytes
 
 

@@ -78,7 +78,6 @@ EmbedParams = Dict[str, jax.Array]
 CHECKPOINT_CHUNK_ELEMS = 128 * 1024 * 1024
 
 
-
 @functools.partial(jax.jit, donate_argnums=0)
 def _write_rows(buf: jax.Array, chunk: jax.Array, start) -> jax.Array:
     """Donated row-range write into a shard buffer (in-place on backends with
@@ -763,13 +762,22 @@ class DistributedEmbedding:
                           else entries[0].dtype)
             plan = self._get_plan(encs, b)
             ids_recv = self._build_send_blocks(plan, entries, comm_dtype)
-            flat_out = self._plan_lookup(plan, params, ids_recv)[0]  # [b, s]
+            # slot-major group outputs: per-instance outputs are plain
+            # slices, skipping the exchange-row transpose the single
+            # worker never needs (only multi-slot instances pay a small
+            # per-instance transpose)
+            reds = self._plan_lookup_groups(plan, params, ids_recv)
             outs = []
             for inst in plan.instances:  # worker order == input order here
                 g = plan.groups[inst.group]
-                c0 = g.col + inst.slot0 * g.width
-                o = lax.slice(flat_out, (0, c0),
-                              (b, c0 + inst.num_slots * g.width))
+                red = reds[inst.group]  # [1, n, b, w]
+                if inst.num_slots == 1:
+                    o = red[0, inst.slot0]
+                else:
+                    o = lax.slice(
+                        red, (0, inst.slot0, 0, 0),
+                        (1, inst.slot0 + inst.num_slots, b, g.width)
+                    )[0].transpose(1, 0, 2).reshape(b, -1)
                 enc = encs[inst.input_id]
                 shape = shapes[inst.input_id]
                 # single-worker parity with the reference's local `call`
@@ -1009,15 +1017,34 @@ class DistributedEmbedding:
         return (s_ix * g.n + f_ix) * (b + 1) + seg
 
     def _plan_lookup(self, plan, params: EmbedParams, ids_recv) -> jax.Array:
-        """All local lookups, one rank-uniform program: per group, one region
-        reshape, one slab gather, one combine. Returns ``[world, b, s_max]``
-        in ``compute_dtype`` (the pre-comm mixed-precision cast, reference
+        """All local lookups in exchange-row layout ``[world, b, s_max]``
+        (``compute_dtype`` — the pre-comm mixed-precision cast, reference
         ``dist_model_parallel.py:300``). Dead slots produce garbage columns
         that no consumer ever slices."""
         world = self.world_size
         b = plan.b
+        # _plan_lookup_groups already casts to compute_dtype; only the
+        # no-groups zeros fallback needs the explicit dtype
+        zdt = (self.compute_dtype
+               or next(iter(params.values())).dtype)
+        sections = [
+            red.transpose(0, 2, 1, 3).reshape(world, b, -1)
+            for red in self._plan_lookup_groups(plan, params, ids_recv)]
+        return (jnp.concatenate(sections, axis=2) if sections
+                else self._vary(jnp.zeros((world, b, plan.s_max), zdt)))
+
+    def _plan_lookup_groups(self, plan, params: EmbedParams,
+                            ids_recv) -> List[jax.Array]:
+        """Per-group combined lookups in slot-major ``[world, n, b, width]``
+        layout: one region reshape, one slab gather, one combine per group.
+        The single-worker forward consumes these directly (its per-instance
+        outputs are plain slot slices), skipping the ``[world, b, s_max]``
+        exchange-row transpose that only the all-to-all needs — the dense
+        model re-stacks outputs feature-major anyway, so the transpose
+        round trip was a pure extra pass at headline shapes."""
+        world = self.world_size
+        b = plan.b
         my = self._my_rank()
-        pdt = next(iter(params.values())).dtype
         sections = []
         for gi, g in enumerate(plan.groups):
             slab = params[_wkey(g.width)]
@@ -1097,12 +1124,9 @@ class DistributedEmbedding:
                         mean = self._plan_row(plan.mean[gi], my)
                         red = jnp.where(mean[None, :, None, None] > 0,
                                         div, red)
-            sections.append(
-                red.transpose(0, 2, 1, 3).reshape(world, b, g.n * g.width))
-        mp = (jnp.concatenate(sections, axis=2) if sections
-              else self._vary(jnp.zeros((world, b, plan.s_max), pdt)))
-        dt = self.compute_dtype
-        return mp.astype(dt) if dt is not None else mp
+            dt = self.compute_dtype
+            sections.append(red.astype(dt) if dt is not None else red)
+        return sections
 
     # ------------------------------------------------------ sparse backward
 
