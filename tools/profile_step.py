@@ -32,7 +32,10 @@ def main():
     # optional 3rd arg: parameter dtype (the bench headline is bf16 params)
     param_dtype = (jnp.bfloat16 if len(sys.argv) > 3
                    and sys.argv[3] == "bf16" else jnp.float32)
-    table_sizes = [min(s, CAP) for s in CRITEO_KAGGLE_SIZES]
+    # optional 4th arg: "uncapped" profiles the full Criteo-Kaggle vocabs
+    uncapped = len(sys.argv) > 4 and sys.argv[4] == "uncapped"
+    table_sizes = (list(CRITEO_KAGGLE_SIZES) if uncapped
+                   else [min(s, CAP) for s in CRITEO_KAGGLE_SIZES])
     cfg = make_cfg(table_sizes, jnp.bfloat16)
     combiner = "sum" if variant == "ragged" else None
     de = DistributedEmbedding(cfg.embedding_configs(combiner=combiner),
@@ -73,9 +76,11 @@ def main():
     def trivial(s, cats_, b_):
         return s.reshape(-1)[0] * 1.0001, s
 
-    sl = state.emb_params["_w128"] if "_w128" in state.emb_params else \
-        next(iter(state.emb_params.values()))
-    dt0 = timed_loop(trivial, sl, (cats, (num, labels)), iters=12)
+    # a SMALL threaded state: threading a full slab would allocate a
+    # second slab-sized output per call (no donation here) and OOM the
+    # uncapped variant
+    dt0 = timed_loop(trivial, jnp.zeros((128,), jnp.float32),
+                     (cats, (num, labels)), iters=12)
     print(f"dispatch floor: {dt0*1e3:.1f} ms", flush=True)
 
     # Phases 1-2 thread a small token through the *inputs* (ids depend on
