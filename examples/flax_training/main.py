@@ -17,6 +17,8 @@ Run (any backend):
     python examples/flax_training/main.py
 Mesh (8 virtual CPU devices):
     DETPU_FORCE_CPU_DEVICES=8 python examples/flax_training/main.py --mesh
+Sparse optax (O(touched-rows) updates on one big table):
+    python examples/flax_training/main.py --sparse
 """
 
 import os
@@ -60,7 +62,58 @@ class RecModel(nn.Module):
         return nn.Dense(1)(x)
 
 
+def sparse_optax_demo():
+    """Third mode (``--sparse``): O(touched-rows) training of one BIG
+    table under plain optax via ``parallel.sparse_optax`` — the reference
+    op layer's IndexedSlices gradient (``embedding_lookup_ops.py:105-122``)
+    without the hybrid trainer. Only the looked-up rows of the table and
+    the Adagrad accumulator are read or written each step."""
+    from distributed_embeddings_tpu.parallel import (
+        apply_sparse_updates, sparse_rows_adagrad, sparse_value_and_grad)
+
+    vocab, width, batch = 2_000_000, 32, 4096
+    table = jnp.zeros((vocab, width), jnp.float32)
+    dense = {"w": jnp.full((width, 1), 0.3, jnp.float32)}
+    tx_dense = optax.adam(1e-2)
+    tx_rows = sparse_rows_adagrad(1.0)
+
+    def loss_fn(dp, outs, y):
+        return jnp.mean((outs[0] @ dp["w"] - y) ** 2)
+
+    f = sparse_value_and_grad(loss_fn, combiners=["sum"])
+
+    import functools
+
+    # donation is what lets the row scatters update the table and the
+    # accumulator in place — without it every step copies both slabs
+    @functools.partial(jax.jit, donate_argnums=(0, 2, 3))
+    def step(table, dense, d_state, r_state, ids, y):
+        loss, (dg, sg) = f(dense, [table], [ids], y)
+        du, d_state = tx_dense.update(dg, d_state, dense)
+        dense = optax.apply_updates(dense, du)
+        ru, r_state = tx_rows.update(sg, r_state, [table])
+        [table] = apply_sparse_updates([table], ru)
+        return table, dense, d_state, r_state, loss
+
+    d_state = tx_dense.init(dense)
+    r_state = tx_rows.init([table])
+    rng = np.random.default_rng(0)
+    loss = None
+    for i in range(60):
+        ids = jnp.asarray(rng.integers(0, 50_000, size=(batch, 2)),
+                          jnp.int32)
+        y = jnp.ones((batch, 1), jnp.float32)
+        table, dense, d_state, r_state, loss = step(
+            table, dense, d_state, r_state, ids, y)
+        if i % 20 == 0:
+            print(f"step {i:3d} loss {float(loss):.4f}")
+    print(f"final loss {float(loss):.4f}  (table {vocab:,} x {width}; "
+          f"each step touches <= {batch * 2:,} rows)")
+
+
 def main():
+    if "--sparse" in sys.argv:
+        return sparse_optax_demo()
     mesh_mode = "--mesh" in sys.argv
     world = len(jax.devices()) if mesh_mode else 1
     de = DistributedEmbedding(
