@@ -1,7 +1,9 @@
 # Build orchestration (reference: Makefile building the CUDA .so; here the
 # native piece is the C++ data-loader/id-generator shared library).
 
-.PHONY: all native test test-fast bench clean pkg
+SHELL := /bin/bash
+
+.PHONY: all native test test-fast bench clean pkg verify check-backend
 
 all: native
 
@@ -18,6 +20,22 @@ test-fast:
 
 bench:
 	python bench.py
+
+# the driver's tier-1 gate (ROADMAP.md "Tier-1 verify", verbatim semantics)
+# plus the static no-eager-backend check — run before shipping a round
+verify: check-backend
+	set -o pipefail; rm -f /tmp/_t1.log; \
+	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+	  -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; \
+	rc=$${PIPESTATUS[0]}; \
+	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
+	exit $$rc
+
+# fails if __graft_entry__.py / bench.py reintroduce a pre-probe backend
+# touch (the r5 rc=124 root cause)
+check-backend:
+	python tools/check_no_eager_backend.py
 
 pkg:
 	python -m build --wheel 2>/dev/null || pip wheel --no-deps -w dist .

@@ -6,6 +6,7 @@ which exports ``embedding_lookup`` and ``__version__``): large-embedding
 recommender training with hybrid model/data parallelism over a TPU mesh.
 """
 
+from . import compat  # noqa: F401 - polyfills jax API gaps (older releases)
 from .version import __version__
 from .ops.embedding_lookup import (
     Ragged,

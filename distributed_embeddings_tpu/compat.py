@@ -1,0 +1,84 @@
+"""Version-compatibility polyfills for the jax API surface this package
+uses.
+
+The package is written against the current jax API (``jax.shard_map``
+public, VMA typing via ``jax.typeof``/``lax.pvary``); deployment images
+often pin older releases (the container baseline is jax 0.4.37, where
+``shard_map`` still lives in ``jax.experimental.shard_map`` and VMA typing
+does not exist). A runtime layer that survives flaky backends but dies on
+an ``AttributeError`` at import is not fault-tolerant — so the gaps are
+bridged here, once, instead of per call site.
+
+Imported for its side effect by the package root. Provides:
+
+* ``jax.shard_map`` — installed from ``jax.experimental.shard_map`` when
+  the public name is missing (keyword-compatible for the subset this
+  package uses: ``mesh``/``in_specs``/``out_specs``; a ``check_vma`` kwarg
+  is translated to the legacy ``check_rep``).
+* :func:`pvary` — mark a constant device-varying under VMA typing;
+  identity on pre-VMA jax, where replicated values join varying values in
+  collectives without explicit casts.
+* :func:`vma_of` — the value's varying-manual-axes set, or ``None`` when
+  the running jax has no VMA typing (callers fall back to pre-VMA
+  semantics; see ``parallel.grads.resolve_dp_gradient``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import lax
+
+HAS_VMA = hasattr(jax, "typeof") and (hasattr(lax, "pvary")
+                                      or hasattr(lax, "pcast"))
+
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def _shard_map(f, mesh=None, in_specs=None, out_specs=None, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, **kwargs)
+
+    jax.shard_map = _shard_map
+
+
+def pvary(x: jax.Array, axis_name: str) -> jax.Array:
+    """Mark a constant as device-varying over ``axis_name`` so it can join
+    varying values in collectives/switch branches under VMA typing; identity
+    on pre-VMA jax (no cast exists or is needed there)."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis_name, to="varying")
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, axis_name)
+    return x
+
+
+def axis_size(axis_name: str):
+    """``lax.axis_size`` polyfill: on pre-VMA jax a ``psum`` of 1 over the
+    axis, which XLA constant-folds to the (static) axis size."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def vma_of(x) -> Optional[frozenset]:
+    """The varying-manual-axes set of ``x``, or ``None`` on pre-VMA jax."""
+    if not hasattr(jax, "typeof"):
+        return None
+    return getattr(jax.typeof(x), "vma", None)
+
+
+def distributed_is_initialized() -> bool:
+    """``jax.distributed.is_initialized`` polyfill: older releases only
+    expose the client handle through the private global state."""
+    if hasattr(jax.distributed, "is_initialized"):
+        return jax.distributed.is_initialized()
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client is not None
+    except Exception:  # noqa: BLE001 - conservatively "not initialized"
+        return False

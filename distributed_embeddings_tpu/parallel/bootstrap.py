@@ -29,6 +29,8 @@ from typing import Optional, Sequence
 import jax
 import numpy as np
 
+from .. import compat
+
 logger = logging.getLogger(__name__)
 
 
@@ -50,36 +52,100 @@ def _cluster_expected() -> bool:
     return False
 
 
+def _join_runtime(coordinator_address: Optional[str],
+                  num_processes: Optional[int],
+                  process_id: Optional[int],
+                  local_device_ids: Optional[Sequence[int]]) -> None:
+    """One join attempt (separated out so tests can stub it and
+    ``DETPU_FAULT=slow:coordinator`` / ``raise:coordinator`` can target
+    it without a real cluster)."""
+    from ..utils import runtime
+
+    runtime.fault_point("coordinator")
+    if compat.distributed_is_initialized():
+        # an earlier attempt that "failed" late (e.g. deadline fired on the
+        # way out) actually completed — initialize() is not idempotent, so
+        # re-invoking it would burn the whole retry budget on its
+        # already-initialized guard
+        return
+    try:
+        if coordinator_address is None and num_processes is None:
+            jax.distributed.initialize()
+        else:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                local_device_ids=local_device_ids)
+    except Exception:
+        # clear any partially-set global state so the NEXT attempt really
+        # rejoins instead of tripping the only-called-once guard. Bounded
+        # by its own fresh deadline: the outer per-attempt alarm has
+        # already fired by the time we get here, and a shutdown tearing
+        # down a half-established connection can itself block
+        try:
+            with runtime.deadline(10.0, label="distributed shutdown"):
+                jax.distributed.shutdown()
+        except Exception:  # noqa: BLE001 - nothing (usable) was set up
+            pass
+        raise
+
+
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None,
-               local_device_ids: Optional[Sequence[int]] = None) -> bool:
+               local_device_ids: Optional[Sequence[int]] = None,
+               timeout_s: Optional[float] = None,
+               retries: int = 2) -> bool:
     """Join the multi-process JAX runtime; safe to call more than once.
 
     With no arguments, relies on ``jax.distributed.initialize``'s cluster
     auto-detection (TPU pod metadata, Slurm, GKE). Returns True if this call
     performed the initialization, False if it was already done or this is a
-    plain single-process run (no args, no detectable cluster). If the
-    environment announces a multi-process job but the join fails, the error
-    propagates — a pod must never silently fall apart into independent
-    single-host trainings.
+    plain single-process run (no args, no detectable cluster).
+
+    Fault tolerance (``utils.runtime``): each join attempt is bounded by
+    ``timeout_s`` (best-effort ``SIGALRM`` deadline; ``None`` = no bound)
+    and a failed attempt is retried up to ``retries`` times with jittered
+    backoff — a *slow* coordinator is a normal operating condition. What a
+    failure ultimately means depends on the environment:
+
+    * cluster expected (explicit coordinator args, or the environment
+      announces a multi-process job): after the retry budget the error is
+      re-raised as :class:`~..utils.runtime.CoordinatorUnreachable` — a pod
+      must never silently fall apart into independent single-host trainings
+      (each believing it is chief);
+    * no cluster detectable: the failure degrades silently into a
+      single-process run, as before.
     """
-    if jax.distributed.is_initialized():
+    if compat.distributed_is_initialized():
         return False
-    if coordinator_address is None and num_processes is None:
+    from ..utils import runtime
+
+    expected = (coordinator_address is not None or num_processes is not None
+                or _cluster_expected())
+
+    def join_once():
+        with runtime.deadline(timeout_s, label="coordinator join"):
+            _join_runtime(coordinator_address, num_processes, process_id,
+                          local_device_ids)
+
+    if not expected:
         try:
-            jax.distributed.initialize()
-        except Exception as e:  # noqa: BLE001 - re-raised when a cluster exists
-            if _cluster_expected():
-                raise
+            join_once()
+        except Exception as e:  # noqa: BLE001 - single-host degradation
             logger.debug("single-process run (no cluster detected): %s", e)
             return False
         return True
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        local_device_ids=local_device_ids)
+    try:
+        runtime.retry(join_once, max_attempts=retries + 1,
+                      describe="coordinator join")
+    except Exception as e:
+        raise runtime.CoordinatorUnreachable(
+            f"cluster expected (coordinator={coordinator_address!r}, "
+            f"num_processes={num_processes!r}, detected="
+            f"{_cluster_expected()}) but the runtime join kept failing "
+            f"after {retries + 1} attempt(s): {e!r}") from e
     return True
 
 

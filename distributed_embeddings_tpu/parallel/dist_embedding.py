@@ -62,6 +62,7 @@ import numpy as np
 from flax import struct
 from jax import lax
 
+from .. import compat
 from ..layers.embedding import default_embeddings_init
 from ..ops.embedding_lookup import (Ragged, SparseIds, ragged_row_ids,
                                     row_to_split)
@@ -124,10 +125,9 @@ def _wkey(width: int) -> str:
 
 def _pvary(x: jax.Array, axis_name: str) -> jax.Array:
     """Mark a constant as device-varying over ``axis_name`` so it can join
-    varying values in collectives/switch branches under VMA typing."""
-    if hasattr(lax, "pcast"):
-        return lax.pcast(x, axis_name, to="varying")
-    return lax.pvary(x, axis_name)
+    varying values in collectives/switch branches under VMA typing (identity
+    on pre-VMA jax — see :mod:`..compat`)."""
+    return compat.pvary(x, axis_name)
 
 
 class DistributedEmbedding:
@@ -1587,6 +1587,9 @@ class DistributedEmbedding:
         that ``np.load`` cannot map back — such sources load as ``|V<n>``
         and are re-viewed as ``src_dtype`` here (required for bf16
         checkpoints; ``utils.checkpoint`` records it in ``meta.json``)."""
+        from ..utils import runtime as _runtime
+
+        _runtime.fault_point("checkpoint_read")
         loaded = [np.load(w, mmap_mode="r") if isinstance(w, str)
                   else np.asarray(w) for w in weights]
         if any(a.dtype.kind == "V" for a in loaded):

@@ -36,7 +36,11 @@ This module restores the sparse pipeline without the hybrid trainer
 
 Padding/out-of-range contract: ids ``>= vocab`` read the clipped last row
 in the forward (like the op layer) and are DROPPED by the update scatters
-(like the hybrid path) — a bad id trains nothing.
+(like the hybrid path) — a bad id trains nothing. NEGATIVE ids clamp to 0
+on both sides: the forward reads row 0 (``jnp.take(mode="clip")``, the op
+layer's read) and the update trains row 0 — symmetric with the read,
+instead of letting JAX's negative-index normalization wrap the scatter to
+unrelated tail rows (ADVICE r5).
 """
 
 from __future__ import annotations
@@ -88,12 +92,17 @@ def unique_ids_static(ids: jax.Array, vocab: int,
     (``cc/kernels/embedding_lookup_kernels.cu:499-515``)."""
     n = ids.shape[0]
     u = min(n, int(vocab) + 1) if max_unique is None else int(max_unique)
-    # clamp above at the vocab sentinel BEFORE sorting: ids > vocab would
-    # otherwise sort past the pad slots (which hold exactly ``vocab``) and
-    # break the ascending-uids property the scatters later declare;
-    # clamping also merges every bad id into the one dropped sentinel entry
-    # while keeping the clipped-last-row forward read identical
-    ids = jnp.minimum(ids.astype(jnp.int32), jnp.int32(vocab))
+    # clamp BOTH ends BEFORE sorting. Above: ids > vocab would otherwise
+    # sort past the pad slots (which hold exactly ``vocab``) and break the
+    # ascending-uids property the scatters later declare; clamping merges
+    # every bad id into the one dropped sentinel entry while keeping the
+    # clipped-last-row forward read identical. Below: a negative id
+    # surviving into uids would read row 0 in the forward (take
+    # mode="clip") but WRAP to a tail row in the update scatters (JAX
+    # negative-index normalization), training an unrelated row — clamping
+    # to 0 makes invalid ids train row 0, symmetric with the read
+    # (module docstring "Padding/out-of-range contract"; ADVICE r5).
+    ids = jnp.clip(ids.astype(jnp.int32), 0, jnp.int32(vocab))
     sorted_ids, perm = lax.sort_key_val(
         ids, jnp.arange(n, dtype=jnp.int32))
     boundary = jnp.concatenate(
@@ -119,12 +128,17 @@ def _flat_stream(inp: IdsLike) -> jax.Array:
 def _remap(inp: IdsLike, inv_slice: jax.Array) -> IdsLike:
     """Rebuild an input with its ids replaced by indices into the unique
     rows (same static encoding, so the remapped lookup reuses
-    :func:`...ops.embedding_lookup` unchanged)."""
+    :func:`...ops.embedding_lookup` unchanged). ``weights`` carry through:
+    positions are unchanged by the remap, so per-id weights stay aligned
+    and the remapped lookup stays bitwise-identical to the direct weighted
+    lookup (a dropped field here silently computed an UNWEIGHTED
+    forward/gradient for weighted inputs — ADVICE r5, medium)."""
     if isinstance(inp, Ragged):
-        return Ragged(values=inv_slice, row_splits=inp.row_splits)
+        return Ragged(values=inv_slice, row_splits=inp.row_splits,
+                      weights=inp.weights)
     if isinstance(inp, SparseIds):
         return SparseIds(indices=inp.indices, values=inv_slice,
-                         dense_shape=inp.dense_shape)
+                         dense_shape=inp.dense_shape, weights=inp.weights)
     return inv_slice.reshape(jnp.asarray(inp).shape)
 
 

@@ -1,5 +1,12 @@
-"""Data pipelines and metrics for the example models."""
+"""Data pipelines, metrics, checkpointing, and the fault-tolerant runtime
+layer for the example models and entry points."""
 
-from .checkpoint import restore_train_state, save_train_state
+from . import runtime
+from .checkpoint import (previous_checkpoint_path, restore_train_state,
+                         save_train_state, verify_checkpoint)
 from .data import DummyDataset, RawBinaryDataset, power_law_ids
 from .metrics import binary_auc
+from .runtime import (BackendProbe, BackendUnavailable, CheckpointCorrupt,
+                      CoordinatorUnreachable, DeadlineExceeded, DeviceSpec,
+                      FaultInjected, SectionRecorder, deadline, fault_point,
+                      probe_backend, require_devices, retry, run_section)

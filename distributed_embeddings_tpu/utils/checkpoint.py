@@ -27,13 +27,40 @@ Layout under ``path/``::
 
 Multi-host: every process calls both functions (the streamed fetches are
 collective); only process 0 writes, and restore reads are per-process.
+
+Fault tolerance (``utils.runtime``): a killed process mid-checkpoint and a
+torn file on disk are normal operating conditions, not fatal errors.
+
+* **Atomic writes.** Every file goes through tmp-file + fsync + rename,
+  and the whole checkpoint is staged in ``<path>.staging`` then swapped
+  into ``<path>`` in one directory rename — a reader never observes a
+  half-written (torn) checkpoint at ``<path>``. One narrow window exists:
+  the swap is two renames (old → ``.prev``, staging → ``path``), so a
+  crash exactly between them leaves ``path`` absent while the old
+  checkpoint sits COMPLETE at ``<path>.prev`` (and the new one at
+  ``<path>.staging``) — :func:`restore_train_state`'s default fallback
+  recovers from ``.prev`` automatically; only torn state is impossible.
+* **Self-validation.** ``meta.json`` records a CRC32 per file; it is
+  written last, so its presence certifies the set. :func:`verify_checkpoint`
+  re-hashes on load and raises
+  :class:`~distributed_embeddings_tpu.utils.runtime.CheckpointCorrupt` on
+  any mismatch (truncation, bit rot, partial external copy).
+* **Previous-checkpoint fallback.** The swap keeps the displaced
+  checkpoint at ``<path>.prev``; :func:`restore_train_state` falls back to
+  it (with a clear log line) instead of loading torn state.
+* ``DETPU_FAULT=die:checkpoint_write`` kills the process inside the write
+  path, so the whole story is testable on CPU (see
+  ``tests/test_checkpoint_atomic.py``).
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import os
-from typing import Any, Optional
+import shutil
+import zlib
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +68,122 @@ import numpy as np
 from flax import serialization
 
 from ..parallel.trainer import HybridTrainState
+from . import runtime
+
+logger = logging.getLogger(__name__)
+
+
+# ------------------------------------------------------- atomic file layer
+
+
+def _crc32_file(path: str, chunk_bytes: int = 1 << 20) -> int:
+    """Streaming CRC32 of a file (constant memory; tables can be GBs)."""
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk_bytes)
+            if not block:
+                break
+            crc = zlib.crc32(block, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _fsync_dir(path: str) -> None:
+    """Best-effort directory fsync so renames inside it are durable."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class _CRCWriter:
+    """File proxy accumulating a CRC32 over sequential writes, so multi-GB
+    table dumps don't need a full re-read to build the manifest. A writer
+    that seeks back (zipfile patching local headers in ``np.savez``)
+    invalidates the running CRC — ``dirty`` flags it and the caller falls
+    back to the streaming re-read."""
+
+    def __init__(self, f):
+        self._f = f
+        self.crc = 0
+        self.dirty = False
+
+    def write(self, data):
+        self.crc = zlib.crc32(data, self.crc)
+        return self._f.write(data)
+
+    def seek(self, *args, **kwargs):
+        self.dirty = True
+        return self._f.seek(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._f, name)
+
+
+def _atomic_file(path: str, writer: Callable[[Any], None]) -> int:
+    """Write ``path`` via tmp + flush + fsync + rename; returns the file's
+    CRC32 (accumulated during the write — see :class:`_CRCWriter`).
+    ``fault_point('checkpoint_write')`` fires first, so an injected death
+    leaves at most a ``.tmp`` orphan — never a torn final file."""
+    runtime.fault_point("checkpoint_write")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        proxy = _CRCWriter(f)
+        writer(proxy)
+        f.flush()
+        os.fsync(f.fileno())
+    crc = _crc32_file(tmp) if proxy.dirty else proxy.crc
+    os.replace(tmp, path)
+    return crc
+
+
+def previous_checkpoint_path(path: str) -> str:
+    """Where the swap parks the displaced checkpoint (restore fallback)."""
+    return path.rstrip(os.sep) + ".prev"
+
+
+def _staging_path(path: str) -> str:
+    return path.rstrip(os.sep) + ".staging"
+
+
+def verify_checkpoint(path: str) -> Dict[str, Any]:
+    """Validate a checkpoint directory; returns its parsed ``meta.json``.
+
+    Raises :class:`~.runtime.CheckpointCorrupt` when the manifest is
+    missing/torn, a listed file is absent, or a CRC32 mismatches. Pre-CRC
+    checkpoints (no ``files`` manifest) pass with a debug note — their
+    files simply cannot be validated.
+    """
+    meta_path = os.path.join(path, "meta.json")
+    if not os.path.isfile(meta_path):
+        raise runtime.CheckpointCorrupt(
+            f"no checkpoint at {path!r} (missing meta.json)")
+    try:
+        with open(meta_path, encoding="utf-8") as f:
+            meta = json.load(f)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise runtime.CheckpointCorrupt(
+            f"torn manifest at {meta_path!r}: {e}") from e
+    files = meta.get("files")
+    if files is None:
+        logger.debug("checkpoint %s predates CRC manifests; skipping "
+                     "content validation", path)
+        return meta
+    for rel, crc in files.items():
+        fp = os.path.join(path, rel)
+        if not os.path.isfile(fp):
+            raise runtime.CheckpointCorrupt(
+                f"checkpoint {path!r} is missing {rel!r}")
+        actual = _crc32_file(fp)
+        if actual != crc:
+            raise runtime.CheckpointCorrupt(
+                f"CRC mismatch for {rel!r} in {path!r}: manifest "
+                f"{crc:#010x}, on disk {actual:#010x} (torn write?)")
+    return meta
 
 
 def _is_slab_dict(tree, params) -> bool:
@@ -82,43 +225,64 @@ def _components(opt_state, params):
 
 
 def save_train_state(path: str, de, state: HybridTrainState,
-                     is_chief: Optional[bool] = None) -> None:
-    """Write the full train state under ``path`` (a directory).
+                     is_chief: Optional[bool] = None,
+                     keep_previous: bool = True) -> None:
+    """Write the full train state under ``path`` (a directory), atomically.
 
     Every process must call this (the streamed table fetches are
-    collective); only the chief writes files."""
+    collective); only the chief writes files.
+
+    The write is crash-safe end to end: files land in ``<path>.staging``
+    (each via tmp + fsync + rename, CRC32s collected into the manifest,
+    ``meta.json`` last) and the staging directory is swapped into ``path``
+    — a process killed at any point never leaves torn state at ``path``:
+    it is either the old checkpoint, the new checkpoint, or (crash exactly
+    between the swap's two renames) absent with the old checkpoint whole
+    at ``<path>.prev``, which restore's fallback picks up. With
+    ``keep_previous`` (the default) the displaced checkpoint survives at
+    ``<path>.prev`` as the restore fallback."""
     if is_chief is None:
         is_chief = jax.process_index() == 0
+    staging = _staging_path(path)
+    manifest: Dict[str, int] = {}
+
+    def put(rel, writer):
+        manifest[rel] = _atomic_file(os.path.join(staging, rel), writer)
+
     if is_chief:
-        os.makedirs(os.path.join(path, "tables"), exist_ok=True)
+        if os.path.isdir(staging):  # leftover of an earlier killed save
+            shutil.rmtree(staging)
+        os.makedirs(os.path.join(staging, "tables"))
     n_tables = len(de.strategy.global_configs)
 
     def dump_tables(sub, comp):
         # table-at-a-time: chief host memory caps at ONE reassembled table
         if is_chief:
-            os.makedirs(os.path.join(path, sub), exist_ok=True)
+            os.makedirs(os.path.join(staging, sub), exist_ok=True)
         for t in range(n_tables):
             arr = de.get_table(comp, t, all_ranks=False)
             if is_chief:
-                np.save(os.path.join(path, sub, f"table_{t:03d}.npy"), arr)
+                put(f"{sub}/table_{t:03d}.npy",
+                    lambda f, a=arr: np.save(f, a))
 
     dump_tables("tables", state.emb_params)
     slabs, aux = _components(state.emb_opt_state, state.emb_params)
     for name, comp in slabs.items():
-        dump_tables(os.path.join("emb_opt", name), comp)
+        dump_tables(f"emb_opt/{name}", comp)
     if is_chief:
-        os.makedirs(os.path.join(path, "emb_opt"), exist_ok=True)
+        os.makedirs(os.path.join(staging, "emb_opt"), exist_ok=True)
         # aux components save per width key (one npz entry each) — stacking
         # across keys would require every key's aux leaf to have the same
         # element count, which only holds for scalar counters (ADVICE r4)
         for name, comp in aux.items():
-            np.savez(os.path.join(path, "emb_opt", f"{name}.npz"),
-                     **{k: np.asarray(v) for k, v in comp.items()})
+            put(f"emb_opt/{name}.npz",
+                lambda f, c=comp: np.savez(
+                    f, **{k: np.asarray(v) for k, v in c.items()}))
         dense = {"dense_params": state.dense_params,
                  "dense_opt_state": state.dense_opt_state,
                  "step": state.step}
-        with open(os.path.join(path, "dense.msgpack"), "wb") as f:
-            f.write(serialization.to_bytes(dense))
+        put("dense.msgpack",
+            lambda f: f.write(serialization.to_bytes(dense)))
 
         def dt(tree):
             return str(jnp.dtype(next(iter(tree.values())).dtype).name)
@@ -131,14 +295,31 @@ def save_train_state(path: str, de, state: HybridTrainState,
                 # (ADVICE r4) — restore reads these unless overridden
                 "dtypes": {"tables": dt(state.emb_params),
                            **{name: dt(comp)
-                              for name, comp in slabs.items()}}}
-        with open(os.path.join(path, "meta.json"), "w") as f:
-            json.dump(meta, f)
+                              for name, comp in slabs.items()}},
+                # per-file CRC32s, manifest written LAST: its presence
+                # certifies every other file hit the disk whole
+                "files": dict(manifest)}
+        _atomic_file(os.path.join(staging, "meta.json"),
+                     lambda f: f.write(json.dumps(meta).encode()))
+        _fsync_dir(staging)
+        # ---- commit: one directory swap; old checkpoint -> <path>.prev
+        runtime.fault_point("checkpoint_commit")
+        prev = previous_checkpoint_path(path)
+        if os.path.isdir(path):
+            if keep_previous and os.path.isfile(
+                    os.path.join(path, "meta.json")):
+                if os.path.isdir(prev):
+                    shutil.rmtree(prev)
+                os.replace(path, prev)
+            else:  # invalid leftovers (or fallback disabled): drop them
+                shutil.rmtree(path)
+        os.replace(staging, path)
+        _fsync_dir(os.path.dirname(os.path.abspath(path)))
 
 
 def restore_train_state(path: str, de, emb_optimizer, dense_template,
-                        dense_tx, mesh=None,
-                        dtype=None) -> HybridTrainState:
+                        dense_tx, mesh=None, dtype=None,
+                        fallback: bool = True) -> HybridTrainState:
     """Rebuild a :class:`HybridTrainState` from :func:`save_train_state`
     output. ``dense_template`` supplies the dense params/opt pytree
     structure (e.g. a freshly initialized state's ``dense_params``);
@@ -149,9 +330,25 @@ def restore_train_state(path: str, de, emb_optimizer, dense_template,
     run resumes with the same mixed dtypes and an unchanged trajectory).
     Pass a single dtype to force it everywhere, or a dict keyed by
     component name (``"tables"``, ``"state"``, ``"state0"``, ...) for
-    per-component overrides (missing keys keep their saved dtype)."""
-    with open(os.path.join(path, "meta.json")) as f:
-        meta = json.load(f)
+    per-component overrides (missing keys keep their saved dtype).
+
+    Validation: the checkpoint is CRC-verified against its manifest before
+    anything loads. A torn checkpoint is never restored — with ``fallback``
+    (the default) the previous valid checkpoint at ``<path>.prev`` is
+    restored instead (clear warning logged); otherwise
+    :class:`~.runtime.CheckpointCorrupt` propagates."""
+    runtime.fault_point("checkpoint_read")
+    try:
+        meta = verify_checkpoint(path)
+    except runtime.CheckpointCorrupt as e:
+        prev = previous_checkpoint_path(path)
+        if not (fallback and os.path.isdir(prev)):
+            raise
+        logger.warning(
+            "checkpoint at %s failed validation (%s); falling back to the "
+            "previous valid checkpoint at %s", path, e, prev)
+        meta = verify_checkpoint(prev)  # must itself be whole, or we raise
+        path = prev
     n = meta["num_tables"]
     saved_dtypes = meta.get("dtypes", {})
 

@@ -84,7 +84,15 @@ flags.DEFINE_string("save_state", None,
 flags.DEFINE_string("restore_state", None,
                     "resume from a --save_state checkpoint directory "
                     "(restores tables, sparse-optimizer state, dense "
-                    "params/optimizer and the step counter)")
+                    "params/optimizer and the step counter; a torn "
+                    "checkpoint falls back to <dir>.prev automatically)")
+flags.DEFINE_float("bootstrap_timeout_s", None,
+                   "per-attempt deadline for the multi-host runtime join "
+                   "(None = jax defaults); a slow coordinator is retried "
+                   "with backoff instead of hanging the pod")
+flags.DEFINE_integer("bootstrap_retries", 2,
+                     "join retry budget before a cluster-expected job "
+                     "fails with CoordinatorUnreachable")
 
 
 def synthetic_batches(cfg, num_batches, batch_size, seed=0):
@@ -102,8 +110,11 @@ def synthetic_batches(cfg, num_batches, batch_size, seed=0):
 
 def main(_):
     # multi-host bootstrap (the reference's hvd.init, main.py:152-157 there):
-    # no-op on a single host; on a pod every host runs this same script
-    bootstrap.initialize()
+    # no-op on a single host; on a pod every host runs this same script.
+    # Deadline-bounded + retried (utils.runtime): a slow coordinator gets
+    # retried, an unreachable one fails loudly instead of hanging forever
+    bootstrap.initialize(timeout_s=FLAGS.bootstrap_timeout_s,
+                         retries=FLAGS.bootstrap_retries)
     is_chief = bootstrap.process_index() == 0
 
     table_sizes = [int(s) for s in FLAGS.table_sizes]

@@ -19,7 +19,8 @@ from distributed_embeddings_tpu.layers import DistributedEmbeddingLayer
 from distributed_embeddings_tpu.ops.embedding_lookup import (
     embedding_lookup as lookup_fn)
 from distributed_embeddings_tpu.ops.embedding_lookup import Ragged
-from distributed_embeddings_tpu.parallel import DistributedEmbedding
+from distributed_embeddings_tpu.parallel import (DistributedEmbedding,
+                                                 resolve_dp_gradient)
 
 WORLD = 8
 
@@ -143,8 +144,13 @@ def test_mesh_training_plain_optax():
 
         loss, (gs, gw) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
             slabs, w)
-        # dp gradient for w (replicated), mp gradients local 1/world scale
-        gw = jax.lax.pmean(gw, "data")
+        # dp gradient for w (replicated): resolve via the library helper —
+        # it absorbs the VMA-vs-legacy autodiff difference (newer jax
+        # auto-psums the replicated-param gradient; pre-VMA jax returns the
+        # per-device contribution) — then restore the summed-gradient
+        # semantics this test's lr was tuned for. mp gradients local,
+        # 1/world scale.
+        gw = resolve_dp_gradient(gw, "data") * WORLD
         gs = jax.tree.map(lambda g: g / WORLD, gs)
         updates, opt_state = tx.update(gs, opt_state, slabs)
         slabs = optax.apply_updates(slabs, updates)

@@ -1,0 +1,280 @@
+"""Fault-tolerance layer (utils/runtime.py) under injected faults — all
+CPU-only, subprocess-based where the failure mode is a hang or a death.
+
+Scenarios (ISSUE r6): probe timeout on a hung backend; require_devices
+falling back to the forced-CPU mesh; dryrun_multichip completing via the
+CPU child while the backend hangs; bootstrap retry-then-succeed,
+retry-then-raise (cluster expected) and silent single-host degradation;
+crash-surviving JSONL section records; bench killed mid-run keeping every
+completed section.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from distributed_embeddings_tpu.parallel import bootstrap
+from distributed_embeddings_tpu.utils import runtime
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv(runtime.FAULT_ENV, raising=False)
+    runtime.reset_fault_counts()
+    yield
+    runtime.reset_fault_counts()
+
+
+# ------------------------------------------------------- fault_point/retry
+
+
+def test_fault_point_modes(monkeypatch):
+    runtime.fault_point("nothing_set")  # no env: no-op
+
+    monkeypatch.setenv(runtime.FAULT_ENV, "raise:ckpt")
+    with pytest.raises(runtime.FaultInjected):
+        runtime.fault_point("ckpt")
+    runtime.fault_point("other_point")  # non-matching point passes
+
+    # budgeted raise: first 2 calls fail, third passes
+    runtime.reset_fault_counts()
+    monkeypatch.setenv(runtime.FAULT_ENV, "raise:join:2")
+    for _ in range(2):
+        with pytest.raises(runtime.FaultInjected):
+            runtime.fault_point("join")
+    runtime.fault_point("join")
+
+    monkeypatch.setenv(runtime.FAULT_ENV, "slow:io:0.05")
+    t0 = time.monotonic()
+    runtime.fault_point("io")
+    assert time.monotonic() - t0 >= 0.05
+
+
+def test_retry_succeeds_after_transient_failures():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert runtime.retry(flaky, max_attempts=5, base_delay_s=0.01) == "ok"
+    assert calls["n"] == 3
+
+
+def test_retry_attempt_budget_reraises():
+    def always():
+        raise ValueError("permanent")
+
+    with pytest.raises(ValueError):
+        runtime.retry(always, max_attempts=2, base_delay_s=0.01)
+
+
+def test_retry_deadline_raises_deadline_exceeded():
+    def always():
+        raise ValueError("permanent")
+
+    with pytest.raises(runtime.DeadlineExceeded):
+        runtime.retry(always, deadline_s=0.05, base_delay_s=0.05)
+
+
+def test_deadline_interrupts_sleep():
+    t0 = time.monotonic()
+    with pytest.raises(runtime.DeadlineExceeded):
+        with runtime.deadline(0.2, label="nap"):
+            time.sleep(30)
+    assert time.monotonic() - t0 < 5
+
+
+# ------------------------------------------------------------------- probe
+
+
+def test_probe_backend_cpu_reports_devices():
+    probe = runtime.probe_backend(timeout_s=120, platform="cpu")
+    assert probe.ok, probe
+    assert probe.platform == "cpu"
+    assert probe.device_count >= 1
+
+
+def test_probe_backend_hang_times_out(monkeypatch):
+    monkeypatch.setenv(runtime.FAULT_ENV, "hang:backend:60")
+    probe = runtime.probe_backend(timeout_s=2)
+    assert not probe.ok
+    assert "timed out" in probe.error
+    assert probe.elapsed_s < 30
+
+
+def test_require_devices_falls_back_to_forced_cpu_mesh(monkeypatch):
+    monkeypatch.setenv(runtime.FAULT_ENV, "hang:backend:60")
+    spec = runtime.require_devices(4, timeout_s=2)
+    assert spec.forced_cpu and spec.device_count == 4
+    env = spec.child_env()
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "--xla_force_host_platform_device_count=4" in env["XLA_FLAGS"]
+
+
+def test_dryrun_multichip_completes_via_cpu_child_with_hung_backend(
+        monkeypatch):
+    """Acceptance: with DETPU_FAULT=hang:backend the dryrun still completes
+    inside its deadline — the parent probes (times out fast), never touches
+    its own backend, and spawns the forced-CPU child."""
+    monkeypatch.setenv(runtime.FAULT_ENV, "hang:backend:120")
+    monkeypatch.syspath_prepend(_REPO)
+    import __graft_entry__ as g
+
+    t0 = time.monotonic()
+    g.dryrun_multichip(2, probe_timeout_s=3, child_timeout_s=420)
+    assert time.monotonic() - t0 < 425
+
+
+# --------------------------------------------------------------- bootstrap
+
+
+def test_bootstrap_single_host_degrades_silently(monkeypatch):
+    for var in ("SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE", "PMI_SIZE",
+                "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+                "MEGASCALE_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES"):
+        monkeypatch.delenv(var, raising=False)
+
+    def broken(*a):
+        raise RuntimeError("no cluster here")
+
+    monkeypatch.setattr(bootstrap, "_join_runtime", broken)
+    assert bootstrap.initialize() is False
+
+
+def test_bootstrap_retries_then_succeeds(monkeypatch):
+    monkeypatch.setenv("SLURM_NTASKS", "2")  # cluster expected
+    calls = {"n": 0}
+
+    def flaky(*a):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("coordinator warming up")
+
+    monkeypatch.setattr(bootstrap, "_join_runtime", flaky)
+    assert bootstrap.initialize(retries=3) is True
+    assert calls["n"] == 3
+
+
+def test_bootstrap_cluster_expected_raises_after_retries(monkeypatch):
+    monkeypatch.setenv("SLURM_NTASKS", "2")
+    calls = {"n": 0}
+
+    def dead(*a):
+        calls["n"] += 1
+        raise RuntimeError("connection refused")
+
+    monkeypatch.setattr(bootstrap, "_join_runtime", dead)
+    with pytest.raises(runtime.CoordinatorUnreachable):
+        bootstrap.initialize(retries=1)
+    assert calls["n"] == 2
+
+
+def test_bootstrap_slow_coordinator_hits_deadline(monkeypatch):
+    """DETPU_FAULT=slow:coordinator + a short per-attempt timeout_s: every
+    attempt times out inside fault_point (before any real jax.distributed
+    call) and a cluster-expected job raises CoordinatorUnreachable."""
+    monkeypatch.setenv("SLURM_NTASKS", "2")
+    monkeypatch.setenv(runtime.FAULT_ENV, "slow:coordinator:30")
+    t0 = time.monotonic()
+    with pytest.raises(runtime.CoordinatorUnreachable):
+        bootstrap.initialize(timeout_s=0.3, retries=1)
+    assert time.monotonic() - t0 < 20
+
+
+# ------------------------------------------- crash-surviving section records
+
+
+def test_section_recorder_survives_process_death(tmp_path):
+    side = str(tmp_path / "sections.jsonl")
+    code = (
+        f"import os, sys; sys.path.insert(0, {_REPO!r})\n"
+        "from distributed_embeddings_tpu.utils import runtime\n"
+        f"rec = runtime.SectionRecorder({side!r})\n"
+        "runtime.run_section(rec, 'alpha', lambda: 1.5)\n"
+        "os.environ[runtime.FAULT_ENV] = 'die:beta'\n"
+        "runtime.run_section(rec, 'beta', lambda: 2.5)\n"
+        "rec.record('never_reached', ok=True)\n")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 17, proc.stderr[-2000:]  # die:* exit code
+    recs = runtime.SectionRecorder.load(side)
+    assert [r["section"] for r in recs] == ["alpha"]
+    assert recs[0]["ok"] and recs[0]["value"] == 1.5
+    # a torn trailing line (killed mid-write) must not break parsing
+    with open(side, "a", encoding="utf-8") as f:
+        f.write('{"section": "torn", "ok"')
+    recs = runtime.SectionRecorder.load(side)
+    assert [r["section"] for r in recs] == ["alpha"]
+
+
+def test_run_section_records_failure_and_returns_default(tmp_path):
+    rec = runtime.SectionRecorder(str(tmp_path / "s.jsonl"))
+
+    def boom():
+        raise RuntimeError("nope")
+
+    out = runtime.run_section(rec, "bad", boom, default="dflt", retries=1)
+    assert out == "dflt"
+    recs = runtime.SectionRecorder.load(rec.path)
+    assert recs[0]["section"] == "bad" and recs[0]["ok"] is False
+    assert recs[0]["attempts"] == 2
+
+
+def test_bench_killed_mid_run_leaves_parseable_sidecar(tmp_path):
+    """Acceptance: bench.py killed mid-run (die:bench.bf16, the second
+    section) leaves a parseable JSONL sidecar containing the probe and
+    every completed section (fp32)."""
+    side = str(tmp_path / "bench.partial.jsonl")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "DETPU_BENCH_SMOKE": "1",
+        "DETPU_BENCH_SIDECAR": side,
+        "DETPU_FAULT": "die:bench.bf16",
+        "PYTHONPATH": _REPO,
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py")],
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 17, (proc.stdout, proc.stderr[-2000:])
+    recs = runtime.SectionRecorder.load(side)
+    by_name = {r["section"]: r for r in recs}
+    assert by_name["probe"]["ok"] is True
+    assert by_name["bench.fp32"]["ok"] is True
+    assert by_name["bench.fp32"]["value"] > 0
+    assert "final" not in by_name  # killed before completion
+
+
+def test_bench_backend_unavailable_emits_parseable_error_record(tmp_path,
+                                                                monkeypatch):
+    """A stalled tunnel must yield one parseable JSON line (error field),
+    not an rc=124 hang with an empty tail."""
+    side = str(tmp_path / "bench.partial.jsonl")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "DETPU_BENCH_SMOKE": "1",
+        "DETPU_BENCH_SIDECAR": side,
+        "DETPU_FAULT": "hang:backend:120",
+        "DETPU_PROBE_TIMEOUT_S": "3",
+        "PYTHONPATH": _REPO,
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py")],
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert "backend unavailable" in out["error"]
+    assert out["value"] == 0.0
+    recs = runtime.SectionRecorder.load(side)
+    assert recs and recs[0]["section"] == "probe"
+    assert recs[0]["ok"] is False

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Static gate: no eager jax backend touch in the driver entry points.
+
+Round 5's artifacts died rc=124 because ``__graft_entry__.py`` called
+``jax.device_count()`` in the parent process before deciding anything —
+a >2 min hang when the TPU tunnel stalls (VERDICT r5). The entry points
+were rewired to decide purely from ``utils.runtime.probe_backend`` (a
+watched subprocess with a timeout); this check keeps the bare calls from
+creeping back in.
+
+Rules, per checked file (``__graft_entry__.py``, ``bench.py``):
+
+* a backend-touching call (``jax.devices``, ``jax.device_count``,
+  ``jax.local_devices``, ``jax.local_device_count``,
+  ``jax.default_backend``) at MODULE scope (incl. the ``__main__`` block)
+  always fails — it runs before any probe can;
+* inside a function it must carry a ``# backend-ok: <reason>`` annotation
+  on the same line, asserting the call only executes in a probe-cleared
+  context (e.g. the dryrun child process).
+
+Runs from ``make verify``. No jax import needed — pure AST.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+BACKEND_ATTRS = {"devices", "device_count", "local_devices",
+                 "local_device_count", "default_backend"}
+MARKER = "backend-ok:"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHECKED_FILES = ("__graft_entry__.py", "bench.py")
+
+
+def _is_backend_call(node: ast.Call) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr in BACKEND_ATTRS
+            and isinstance(f.value, ast.Name) and f.value.id == "jax")
+
+
+def check_file(path: str) -> list:
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    lines = src.splitlines()
+    errors = []
+
+    def walk(node, in_function):
+        for child in ast.iter_child_nodes(node):
+            child_in_fn = in_function or isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda))
+            if isinstance(child, ast.Call) and _is_backend_call(child):
+                where = f"{os.path.relpath(path, REPO)}:{child.lineno}"
+                line = lines[child.lineno - 1]
+                if not in_function:
+                    errors.append(
+                        f"{where}: module-scope jax.{child.func.attr}() — "
+                        "runs before any backend probe and hangs the "
+                        "process on a stalled tunnel; route through "
+                        "utils.runtime.probe_backend/require_devices")
+                elif MARKER not in line:
+                    errors.append(
+                        f"{where}: jax.{child.func.attr}() without a "
+                        f"'# {MARKER} <reason>' annotation — either probe "
+                        "first (utils.runtime) or annotate why this only "
+                        "executes in a probe-cleared context")
+            walk(child, child_in_fn)
+
+    walk(ast.parse(src, path), False)
+    return errors
+
+
+def main() -> int:
+    errors = []
+    for name in CHECKED_FILES:
+        path = os.path.join(REPO, name)
+        if not os.path.exists(path):
+            errors.append(f"{name}: checked file missing")
+            continue
+        errors.extend(check_file(path))
+    for e in errors:
+        print(f"check_no_eager_backend: {e}", file=sys.stderr)
+    if not errors:
+        print("check_no_eager_backend: OK "
+              f"({', '.join(CHECKED_FILES)} clean)")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
