@@ -3,7 +3,8 @@
 
 SHELL := /bin/bash
 
-.PHONY: all native test test-fast bench clean pkg verify check-backend
+.PHONY: all native test test-fast bench bench-diff clean pkg verify \
+        check-backend check-obs
 
 all: native
 
@@ -22,8 +23,9 @@ bench:
 	python bench.py
 
 # the driver's tier-1 gate (ROADMAP.md "Tier-1 verify", verbatim semantics)
-# plus the static no-eager-backend check — run before shipping a round
-verify: check-backend
+# plus the static no-eager-backend check and the observability gate — run
+# before shipping a round
+verify: check-backend check-obs
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -36,6 +38,18 @@ verify: check-backend
 # touch (the r5 rc=124 root cause)
 check-backend:
 	python tools/check_no_eager_backend.py
+
+# observability gate: obs.py imports cleanly under JAX_PLATFORMS=cpu and
+# the DETPU_OBS=1 smoke bench emits a parseable step-metrics sidecar
+check-obs:
+	python tools/check_obs.py
+
+# optional regression gate: diff two BENCH records, nonzero exit on a >10%
+# throughput regression. Usage: make bench-diff OLD=BENCH_r04.json NEW=out.json
+OLD ?= $(lastword $(sort $(wildcard BENCH_r*.json)))
+NEW ?= BENCH.json
+bench-diff:
+	python tools/compare_bench.py $(OLD) $(NEW)
 
 pkg:
 	python -m build --wheel 2>/dev/null || pip wheel --no-deps -w dist .

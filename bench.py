@@ -57,7 +57,7 @@ from distributed_embeddings_tpu.ops.embedding_lookup import Ragged
 from distributed_embeddings_tpu.parallel import (
     DistributedEmbedding, HybridTrainState, SparseSGD,
     make_hybrid_train_loop, make_hybrid_train_step)
-from distributed_embeddings_tpu.utils import power_law_ids
+from distributed_embeddings_tpu.utils import obs, power_law_ids
 
 CRITEO_KAGGLE_SIZES = [
     1460, 583, 10131227, 2202608, 305, 24, 12517, 633, 3, 93145, 5683,
@@ -92,6 +92,9 @@ if SMOKE:
     C1TB_STEPS_PER_CALL = 2
 # crash-surviving per-section record (see module docstring)
 SIDECAR_PATH = os.environ.get("DETPU_BENCH_SIDECAR", "BENCH.partial.jsonl")
+# step-metrics sidecar (observability layer): written only under DETPU_OBS=1
+OBS_SIDECAR_PATH = os.environ.get("DETPU_OBS_SIDECAR", "BENCH.metrics.jsonl")
+_METRICS_LOGGER = None  # bound by main() when DETPU_OBS=1
 PROBE_TIMEOUT_S = float(os.environ.get("DETPU_PROBE_TIMEOUT_S", "120"))
 SECTION_DEADLINE_S = float(
     os.environ.get("DETPU_BENCH_SECTION_DEADLINE_S", "1200"))
@@ -177,9 +180,18 @@ def build_state(de, dense, cfg, emb_opt, tx, table_sizes, param_dtype,
 
 def run_dlrm(table_sizes, compute_dtype, param_dtype=jnp.float32,
              ragged_hotness=None, batch=None,
-             steps_per_call=DLRM_STEPS_PER_CALL):
+             steps_per_call=DLRM_STEPS_PER_CALL,
+             metrics_variant=None):
     """One DLRM variant; returns samples/s. ``ragged_hotness`` switches the
     26 features to variable-hotness Ragged inputs with that mean hotness.
+
+    ``metrics_variant`` names this variant in the step-metrics sidecar:
+    under ``DETPU_OBS=1`` one *instrumented* step runs before the timed
+    loop (its state output feeds the loop, so nothing is wasted) and its
+    on-device metrics — exchange bytes, routed-id counts, overflow
+    counters — are logged. The TIMED program itself is always built with
+    ``with_metrics=False`` so the headline numbers measure the same
+    program with or without ``DETPU_OBS``.
 
     Timing drives ``steps_per_call`` distinct pre-staged batches through ONE
     compiled program per dispatch (``make_hybrid_train_loop``'s ``lax.scan``)
@@ -235,14 +247,25 @@ def run_dlrm(table_sizes, compute_dtype, param_dtype=jnp.float32,
         n, y = batch
         return bce_with_logits(dense.apply(dp, n, emb_outs), y)
 
+    cats1 = jax.tree.map(lambda a: a[0], cat_stacks)
+    if _METRICS_LOGGER is not None and metrics_variant is not None:
+        # one instrumented step with a profile capture; the donated state
+        # it returns seeds the timed loop below
+        mstep = make_hybrid_train_step(de, loss_fn, tx, emb_opt,
+                                       lr_schedule=0.005, with_metrics=True)
+        with obs.profile_trace(f"bench_{metrics_variant}"):
+            _, state, metrics = mstep(state, cats1, (num, labels))
+        _METRICS_LOGGER.log_step(metrics, variant=metrics_variant,
+                                 summary=obs.summarize(metrics))
+
     if K == 1:
         step_fn = make_hybrid_train_step(de, loss_fn, tx, emb_opt,
-                                         lr_schedule=0.005)
-        cats1 = jax.tree.map(lambda a: a[0], cat_stacks)
+                                         lr_schedule=0.005,
+                                         with_metrics=False)
         dt = timed_loop(step_fn, state, (cats1, (num, labels)))
         return batch / dt
     loop_fn = make_hybrid_train_loop(de, loss_fn, tx, emb_opt,
-                                     lr_schedule=0.005)
+                                     lr_schedule=0.005, with_metrics=False)
     dt = timed_loop(loop_fn, state,
                     (cat_stacks, (num_stack, lab_stack)), iters=4)
     return batch * K / dt
@@ -285,7 +308,7 @@ def run_tiny_zoo(opt_name, steps_per_call=ZOO_STEPS_PER_CALL,
     state = init_hybrid_state(de, emb_opt, dense_params, tx,
                               jax.random.key(1), dtype=param_dtype)
     loop_fn = make_hybrid_train_loop(de, loss_fn, tx, emb_opt,
-                                     lr_schedule=0.01)
+                                     lr_schedule=0.01, with_metrics=False)
     dt = timed_loop(loop_fn, state,
                     (cat_stacks, (num_stack, lab_stack)), iters=4)
     return dt / K * 1e3
@@ -537,14 +560,23 @@ def _input_pipeline_body(root, rng, n, world):
 
 
 def main():
-    global _RECORDER
+    global _RECORDER, _METRICS_LOGGER
     from distributed_embeddings_tpu.utils import runtime
 
+    t_start = time.time()
     # fresh sidecar per run (the previous run's record belongs to the
     # driver's copy of it, not to this run)
     if os.path.exists(SIDECAR_PATH):
         os.remove(SIDECAR_PATH)
     _RECORDER = runtime.SectionRecorder(SIDECAR_PATH)
+    if obs.metrics_enabled():
+        # recompile counter must be listening BEFORE the first jit; the
+        # metrics sidecar is fresh per run like the section sidecar
+        if os.path.exists(OBS_SIDECAR_PATH):
+            os.remove(OBS_SIDECAR_PATH)
+        _METRICS_LOGGER = obs.MetricsLogger(OBS_SIDECAR_PATH)
+        obs.install_compile_listener()
+        obs.maybe_start_server()
     # time-boxed first backend touch, in a watched subprocess: a stalled
     # device tunnel must produce a parseable error record, not an rc=124
     probe = runtime.probe_backend(timeout_s=PROBE_TIMEOUT_S)
@@ -556,11 +588,18 @@ def main():
             "error": f"backend unavailable: {probe.error}",
             "probe": probe.to_json()}))
         return
+    # environment stamp: lets compare_bench refuse to diff records from
+    # different backends / device counts / jax versions (BENCH_r* rounds
+    # were previously only comparable by convention)
+    env_meta = dict(obs.env_stamp(), backend=probe.platform,
+                    device_count=probe.device_count, smoke=SMOKE)
+    _RECORDER.record("meta", ok=True, value=env_meta)
 
     capped = [min(s, CAP) for s in CRITEO_KAGGLE_SIZES]
     cfg_probe = make_cfg(capped, jnp.bfloat16)
 
-    fp32 = _guard("fp32", lambda: run_dlrm(capped, jnp.float32), 0.0)
+    fp32 = _guard("fp32", lambda: run_dlrm(capped, jnp.float32,
+                                           metrics_variant="fp32"), 0.0)
     # rounds 1-3 comparable capture: bf16 compute over fp32 tables
     bf16 = _guard("bf16", lambda: run_dlrm(capped, jnp.bfloat16), 0.0)
     # headline candidate: bf16 tables too (the reference's headline is AMP —
@@ -590,7 +629,8 @@ def main():
     # compiles on the CPU backend); samples/s is batch-insensitive here.
     ragged = _guard("multihot_ragged", lambda: run_dlrm(
         capped, jnp.bfloat16, ragged_hotness=15,
-        batch=BATCH if SMOKE else 16384))
+        batch=BATCH if SMOKE else 16384,
+        metrics_variant="multihot_ragged"))
     # the north-star model itself: heaviest v5e-16 rank shard of
     # Criteo-1TB, global batch of ids, bf16 (VERDICT r3 Missing #1)
     c1tb = _guard("criteo1tb_shard", lambda: run_criteo1tb_shard())
@@ -702,6 +742,13 @@ def main():
             k: rec.get(k) for k in ("ok", "elapsed_s", "error")
             if rec.get(k) is not None}
     out["sections"] = sections
+    out["env"] = dict(env_meta, wall_time_s=round(time.time() - t_start, 1))
+    if _METRICS_LOGGER is not None:
+        # final counters record: recompiles (compile listener), runtime
+        # retries, fault injections — the acceptance's recompile count
+        _METRICS_LOGGER.log_counters(
+            wall_time_s=round(time.time() - t_start, 1))
+        out["obs_counters"] = obs.counters()
     if SMOKE:
         out["smoke"] = True
     _RECORDER.record("final", ok=True, value=out)

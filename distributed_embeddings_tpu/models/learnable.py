@@ -114,8 +114,11 @@ def train_dlrm_convergence(task: LearnableClicks, *, world_size: int = 1,
     state = init_hybrid_state(
         de, emb_opt, dp, tx, jax.random.key(seed + 1), mesh=mesh,
         **({"dtype": param_dtype} if param_dtype is not None else {}))
+    # convergence probe, not a training loop: keep the 2-tuple step
+    # contract even when the environment sets DETPU_OBS=1
     step = make_hybrid_train_step(de, loss_fn, tx, emb_opt, mesh=mesh,
-                                  lr_schedule=lr_schedule)
+                                  lr_schedule=lr_schedule,
+                                  with_metrics=False)
     eval_fn = make_hybrid_eval_step(
         de, lambda d, outs, num: jax.nn.sigmoid(dense.apply(d, num, outs)),
         mesh=mesh)

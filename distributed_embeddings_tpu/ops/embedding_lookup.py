@@ -152,6 +152,7 @@ def ragged_row_ids(row_splits: jax.Array, capacity: int) -> jax.Array:
     return jnp.cumsum(marks[:capacity]).astype(row_splits.dtype)
 
 
+@jax.named_scope("detpu/ragged_combine")
 def _ragged_combine(params: jax.Array, values: jax.Array, row_splits: jax.Array,
                     combiner: str, weights: Optional[jax.Array]) -> jax.Array:
     """Fused gather + segment-reduce for CSR input. The XLA analogue of the

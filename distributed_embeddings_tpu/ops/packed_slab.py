@@ -112,6 +112,7 @@ def pack_rows(x: jax.Array, width: int) -> jax.Array:
     return out
 
 
+@jax.named_scope("detpu/packed_gather")
 def packed_gather(slab: jax.Array, logical_ids: jax.Array,
                   width: int) -> jax.Array:
     """Gather logical rows from a packed slab: ``[..., w]`` for any id
@@ -146,6 +147,7 @@ def packed_gather(slab: jax.Array, logical_ids: jax.Array,
     return out.reshape(*logical_ids.shape, width)
 
 
+@jax.named_scope("detpu/expand_update_rows")
 def expand_update_rows(vals: jax.Array, logical_ids: jax.Array,
                        width: int) -> Tuple[jax.Array, jax.Array]:
     """Turn ``[n, w]`` update rows at logical ids into ``(phys_ids,

@@ -20,6 +20,7 @@ from .optimizers import SparseAdagrad, SparseAdam, SparseMomentum, SparseSGD
 from .sparse_optax import (
     SparseRows,
     apply_sparse_updates,
+    sparse_grad_metrics,
     sparse_rows_adagrad,
     sparse_rows_adam,
     sparse_rows_momentum,

@@ -130,13 +130,20 @@ def initialize(coordinator_address: Optional[str] = None,
             _join_runtime(coordinator_address, num_processes, process_id,
                           local_device_ids)
 
+    import time
+
+    from ..utils import obs
+
+    t0 = time.monotonic()
     if not expected:
         try:
             join_once()
         except Exception as e:  # noqa: BLE001 - single-host degradation
             logger.debug("single-process run (no cluster detected): %s", e)
             return False
+        _log_join_success(coordinator_address, time.monotonic() - t0)
         return True
+    retries_before = obs.counters().get("runtime_retries", 0)
     try:
         runtime.retry(join_once, max_attempts=retries + 1,
                       describe="coordinator join")
@@ -146,7 +153,32 @@ def initialize(coordinator_address: Optional[str] = None,
             f"num_processes={num_processes!r}, detected="
             f"{_cluster_expected()}) but the runtime join kept failing "
             f"after {retries + 1} attempt(s): {e!r}") from e
+    # runtime.retry already bumped the global retry counter per attempt;
+    # mirror the delta into a bootstrap-specific counter so a metrics
+    # record can distinguish "the coordinator was slow" from other retries
+    delta = obs.counters().get("runtime_retries", 0) - retries_before
+    if delta:
+        obs.counter_inc("bootstrap_retries", delta)
+    _log_join_success(coordinator_address, time.monotonic() - t0)
     return True
+
+
+def _log_join_success(coordinator_address: Optional[str],
+                      elapsed_s: float) -> None:
+    """One INFO line on the success path (the failure paths already log):
+    which coordinator, which process slot, how long the join took. Called
+    once per successful :func:`initialize`, never per retry attempt."""
+    addr = (coordinator_address
+            or os.environ.get("JAX_COORDINATOR_ADDRESS")
+            or os.environ.get("COORDINATOR_ADDRESS")
+            or "auto-detected")
+    try:
+        pidx, pcnt = jax.process_index(), jax.process_count()
+    except Exception:  # noqa: BLE001 - logging must never fail the join
+        pidx, pcnt = -1, -1
+    logger.info(
+        "bootstrap: joined runtime as process %d/%d (coordinator %s) "
+        "in %.2fs", pidx, pcnt, addr, elapsed_s)
 
 
 def process_count() -> int:

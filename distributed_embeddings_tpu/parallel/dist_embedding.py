@@ -63,6 +63,7 @@ from flax import struct
 from jax import lax
 
 from .. import compat
+from ..utils import obs
 from ..layers.embedding import default_embeddings_init
 from ..ops.embedding_lookup import (Ragged, SparseIds, ragged_row_ids,
                                     row_to_split)
@@ -815,8 +816,10 @@ class DistributedEmbedding:
             # reference pads to the max per-rank split instead
             # (dist_model_parallel.py:273-282) — same idea, but static
             # regions let the lookup below run without per-rank branches.
-            ids_send = self._build_send_blocks(plan, entries, comm_dtype)
-            ids_recv = lax.all_to_all(ids_send, self.axis_name, 0, 0, tiled=True)
+            with obs.scope("id_all_to_all"):
+                ids_send = self._build_send_blocks(plan, entries, comm_dtype)
+                ids_recv = lax.all_to_all(ids_send, self.axis_name, 0, 0,
+                                          tiled=True)
         else:
             # --- model-parallel input: this rank already holds the global
             # batch of ids for its local tables; no id exchange runs
@@ -848,7 +851,8 @@ class DistributedEmbedding:
         mp_out = self._plan_lookup(plan, params, ids_recv)  # [world, b, s_max]
 
         # --- mp -> dp output exchange --------------------------------------
-        dp_recv = lax.all_to_all(mp_out, self.axis_name, 0, 0, tiled=True)
+        with obs.scope("out_all_to_all"):
+            dp_recv = lax.all_to_all(mp_out, self.axis_name, 0, 0, tiled=True)
         # dp_recv[r] = this rank's batch as computed by source rank r.
 
         # --- unpack (static slices), reorder, concat column slices ---------
@@ -986,19 +990,20 @@ class DistributedEmbedding:
         (row-sliced slots) is subtracted from the raw values before the
         clip — ``values`` stays raw so callers mask consistently."""
         world = self.world_size
-        r3 = region.reshape(world, g.n, g.blen)
-        values = r3[:, :, :g.hot]
-        lengths = r3[:, :, g.hot:g.hot + b]  # "rw" blocks carry weight
-        # bits past the lengths (decoded by _region_weights)
-        if valid is not None:
-            lengths = lengths * valid[None, :, None].astype(r3.dtype)
-        _, seg = self._csr_seg(lengths, g.hot)
-        loc = (values - rbase[None, :, None] if rbase is not None
-               else values)
-        grow = (jnp.clip(loc, 0, (rows - 1)[None, :, None])
-                + roff[None, :, None])
-        counts = jnp.maximum(lengths, 1) if need_counts else None
-        return values, lengths, seg, grow, counts
+        with obs.scope("ragged_decode"):
+            r3 = region.reshape(world, g.n, g.blen)
+            values = r3[:, :, :g.hot]
+            lengths = r3[:, :, g.hot:g.hot + b]  # "rw" blocks carry weight
+            # bits past the lengths (decoded by _region_weights)
+            if valid is not None:
+                lengths = lengths * valid[None, :, None].astype(r3.dtype)
+            _, seg = self._csr_seg(lengths, g.hot)
+            loc = (values - rbase[None, :, None] if rbase is not None
+                   else values)
+            grow = (jnp.clip(loc, 0, (rows - 1)[None, :, None])
+                    + roff[None, :, None])
+            counts = jnp.maximum(lengths, 1) if need_counts else None
+            return values, lengths, seg, grow, counts
 
     def _region_weights(self, g, b: int, region) -> jax.Array:
         """Decode a weighted-ragged ("rw") region's per-id weights
@@ -1042,91 +1047,102 @@ class DistributedEmbedding:
         exchange-row transpose that only the all-to-all needs — the dense
         model re-stacks outputs feature-major anyway, so the transpose
         round trip was a pure extra pass at headline shapes."""
-        world = self.world_size
-        b = plan.b
         my = self._my_rank()
         sections = []
         for gi, g in enumerate(plan.groups):
-            slab = params[_wkey(g.width)]
-            rows = self._plan_row(plan.rows[gi], my)
-            roff = self._plan_row(plan.roff[gi], my)
-            # mean/valid are *static* plan tensors: when no slot on any rank
-            # is a mean combiner (resp. dead), the divide (resp. mask) is
-            # skipped at trace time — sum-only groups never touch counts
-            any_mean = bool(plan.mean[gi].any())
-            all_mean = bool(plan.mean[gi].all())
-            all_valid = bool((plan.valid[gi] > 0).all())
-            # row-sliced slots subtract their range base and must read zero
-            # outside the range (their outputs SUM across slices); the same
-            # mask doubles as the opt-in masked_reads debug contract. The
-            # mask is gated PER SLOT (plan.rsliced): an unsliced table that
-            # shares the exchange group keeps the documented
-            # clip-to-last-row read unless masked_reads=True.
-            any_rslice = bool(plan.rsliced[gi].any())
-            use_mask = any_rslice or self.masked_reads
-            rbase = (self._plan_row(plan.rbase[gi], my) if any_rslice
-                     else None)
-            region = lax.slice(ids_recv, (0, g.goff),
-                               (world, g.goff + g.n * g.blen))
-            if g.kind == "d":
-                ids = region.reshape(world, g.n, b, g.hot)
-                if rbase is not None:
-                    ids = ids - rbase[None, :, None, None]
-                grow = (jnp.clip(ids, 0, (rows - 1)[None, :, None, None])
-                        + roff[None, :, None, None])
-                gath = ps.packed_gather(slab, grow, g.width)
-                if use_mask:
-                    inr = ((ids >= 0) & (ids < rows[None, :, None, None]))
-                    if not self.masked_reads:  # only sliced slots mask
-                        rsl = self._plan_row(plan.rsliced[gi], my)
-                        inr = inr | (rsl[None, :, None, None] == 0)
-                    gath = gath * inr[..., None].astype(gath.dtype)
-                red = jnp.sum(gath, axis=3)  # [world, n, b, w]
-                if g.hot > 1 and any_mean:
-                    if all_mean:
-                        red = red / g.hot
-                    else:
-                        mean = self._plan_row(plan.mean[gi], my)
-                        red = jnp.where(mean[None, :, None, None] > 0,
-                                        red / g.hot, red)
-            else:
-                values, _, seg, grow, counts = self._ragged_decode(
-                    g, b, region, rows, roff,
-                    None if all_valid else self._plan_row(plan.valid[gi], my),
-                    need_counts=any_mean, rbase=rbase)
-                gath = ps.packed_gather(slab, grow, g.width)  # [w, n, cap, ww]
-                if g.kind == "rw":
-                    # per-id weights multiply the gathered rows (reference
-                    # kernel's optional weights, .cu:52-55); mean still
-                    # divides by the id count (.cu:220-222)
-                    wts = self._region_weights(g, b, region)
-                    gath = gath * wts[..., None].astype(gath.dtype)
-                if use_mask:
-                    loc = (values - rbase[None, :, None]
-                           if rbase is not None else values)
-                    inr = ((loc >= 0) & (loc < rows[None, :, None]))
-                    if not self.masked_reads:  # only sliced slots mask
-                        rsl = self._plan_row(plan.rsliced[gi], my)
-                        inr = inr | (rsl[None, :, None] == 0)
-                    gath = gath * inr[..., None].astype(gath.dtype)
-                sidx = self._ragged_scatter_idx(g, b, world, seg)
-                buf = jnp.zeros((world * g.n * (b + 1), g.width), gath.dtype)
-                # sidx ascends globally: (source, slot) blocks are laid out
-                # ascending and seg ascends within each CSR block
-                buf = buf.at[sidx.reshape(-1)].add(
-                    gath.reshape(-1, g.width), indices_are_sorted=True)
-                red = buf.reshape(world, g.n, b + 1, g.width)[:, :, :b, :]
-                if any_mean:
-                    div = red / counts[..., None].astype(red.dtype)
-                    if all_mean:
-                        red = div
-                    else:
-                        mean = self._plan_row(plan.mean[gi], my)
-                        red = jnp.where(mean[None, :, None, None] > 0,
-                                        div, red)
+            # one named scope per (width, kind) group: a profile of the
+            # step attributes gather/combine time to the width it serves
+            with obs.scope(f"lookup_w{g.width}_{g.kind}"):
+                red = self._lookup_group(plan, gi, g, params[_wkey(g.width)],
+                                         ids_recv, my, plan.b)
             dt = self.compute_dtype
             sections.append(red.astype(dt) if dt is not None else red)
         return sections
+
+    def _lookup_group(self, plan, gi: int, g, slab, ids_recv, my,
+                      b: int) -> jax.Array:
+        """One exchange group's combined lookup in slot-major
+        ``[world, n, b, width]`` layout (the body of
+        :meth:`_plan_lookup_groups`, split out so each group runs under its
+        own named scope)."""
+        world = self.world_size
+        rows = self._plan_row(plan.rows[gi], my)
+        roff = self._plan_row(plan.roff[gi], my)
+        # mean/valid are *static* plan tensors: when no slot on any rank
+        # is a mean combiner (resp. dead), the divide (resp. mask) is
+        # skipped at trace time — sum-only groups never touch counts
+        any_mean = bool(plan.mean[gi].any())
+        all_mean = bool(plan.mean[gi].all())
+        all_valid = bool((plan.valid[gi] > 0).all())
+        # row-sliced slots subtract their range base and must read zero
+        # outside the range (their outputs SUM across slices); the same
+        # mask doubles as the opt-in masked_reads debug contract. The
+        # mask is gated PER SLOT (plan.rsliced): an unsliced table that
+        # shares the exchange group keeps the documented
+        # clip-to-last-row read unless masked_reads=True.
+        any_rslice = bool(plan.rsliced[gi].any())
+        use_mask = any_rslice or self.masked_reads
+        rbase = (self._plan_row(plan.rbase[gi], my) if any_rslice
+                 else None)
+        region = lax.slice(ids_recv, (0, g.goff),
+                           (world, g.goff + g.n * g.blen))
+        if g.kind == "d":
+            ids = region.reshape(world, g.n, b, g.hot)
+            if rbase is not None:
+                ids = ids - rbase[None, :, None, None]
+            grow = (jnp.clip(ids, 0, (rows - 1)[None, :, None, None])
+                    + roff[None, :, None, None])
+            gath = ps.packed_gather(slab, grow, g.width)
+            if use_mask:
+                inr = ((ids >= 0) & (ids < rows[None, :, None, None]))
+                if not self.masked_reads:  # only sliced slots mask
+                    rsl = self._plan_row(plan.rsliced[gi], my)
+                    inr = inr | (rsl[None, :, None, None] == 0)
+                gath = gath * inr[..., None].astype(gath.dtype)
+            red = jnp.sum(gath, axis=3)  # [world, n, b, w]
+            if g.hot > 1 and any_mean:
+                if all_mean:
+                    red = red / g.hot
+                else:
+                    mean = self._plan_row(plan.mean[gi], my)
+                    red = jnp.where(mean[None, :, None, None] > 0,
+                                    red / g.hot, red)
+        else:
+            values, _, seg, grow, counts = self._ragged_decode(
+                g, b, region, rows, roff,
+                None if all_valid else self._plan_row(plan.valid[gi], my),
+                need_counts=any_mean, rbase=rbase)
+            gath = ps.packed_gather(slab, grow, g.width)  # [w, n, cap, ww]
+            if g.kind == "rw":
+                # per-id weights multiply the gathered rows (reference
+                # kernel's optional weights, .cu:52-55); mean still
+                # divides by the id count (.cu:220-222)
+                wts = self._region_weights(g, b, region)
+                gath = gath * wts[..., None].astype(gath.dtype)
+            if use_mask:
+                loc = (values - rbase[None, :, None]
+                       if rbase is not None else values)
+                inr = ((loc >= 0) & (loc < rows[None, :, None]))
+                if not self.masked_reads:  # only sliced slots mask
+                    rsl = self._plan_row(plan.rsliced[gi], my)
+                    inr = inr | (rsl[None, :, None] == 0)
+                gath = gath * inr[..., None].astype(gath.dtype)
+            sidx = self._ragged_scatter_idx(g, b, world, seg)
+            buf = jnp.zeros((world * g.n * (b + 1), g.width), gath.dtype)
+            # sidx ascends globally: (source, slot) blocks are laid out
+            # ascending and seg ascends within each CSR block
+            buf = buf.at[sidx.reshape(-1)].add(
+                gath.reshape(-1, g.width), indices_are_sorted=True)
+            red = buf.reshape(world, g.n, b + 1, g.width)[:, :, :b, :]
+            if any_mean:
+                div = red / counts[..., None].astype(red.dtype)
+                if all_mean:
+                    red = div
+                else:
+                    mean = self._plan_row(plan.mean[gi], my)
+                    red = jnp.where(mean[None, :, None, None] > 0,
+                                    div, red)
+        return red
 
     # ------------------------------------------------------ sparse backward
 
@@ -1142,30 +1158,34 @@ class DistributedEmbedding:
         new_state = dict(opt_state) if isinstance(opt_state, dict) else opt_state
         wants_mask = getattr(optimizer, "needs_touch_mask", False)
         for k in sorted(per_width):
-            tris = per_width[k]
-            w = tris[0][2]
-            ids = jnp.concatenate([t[0].reshape(-1) for t in tris])
-            vals = jnp.concatenate(
-                [t[1].reshape(-1, w) for t in tris]) * scale
-            # lane-expand to physical rows: the scatter (and any dedup in the
-            # optimizer) runs on full-tile rows; lane-disjoint placement keeps
-            # per-logical-row semantics exact (ops/packed_slab.py)
-            phys_ids, pvals = ps.expand_update_rows(vals, ids, w)
-            kw = {}
-            if wants_mask:
-                # compact [n, p] lane mask rides the optimizer's dedup and
-                # expands to lanes after (ops/packed_slab.py:lane_one_hot)
-                m = ps.lane_one_hot(ids, w, dtype=pvals.dtype)
-                if m is not None:
-                    kw["mask"] = m
-                    kw["lane_width"] = w
-            slab = new_params[k]
-            st = new_state[k] if isinstance(new_state, dict) else new_state
-            slab, st = optimizer.apply_rows(slab, st, phys_ids, pvals, lr,
-                                            **kw)
-            new_params[k] = slab
-            if isinstance(new_state, dict):
-                new_state[k] = st
+            with obs.scope(f"sparse_apply_{k}"):
+                tris = per_width[k]
+                w = tris[0][2]
+                ids = jnp.concatenate([t[0].reshape(-1) for t in tris])
+                vals = jnp.concatenate(
+                    [t[1].reshape(-1, w) for t in tris]) * scale
+                # lane-expand to physical rows: the scatter (and any dedup
+                # in the optimizer) runs on full-tile rows; lane-disjoint
+                # placement keeps per-logical-row semantics exact
+                # (ops/packed_slab.py)
+                phys_ids, pvals = ps.expand_update_rows(vals, ids, w)
+                kw = {}
+                if wants_mask:
+                    # compact [n, p] lane mask rides the optimizer's dedup
+                    # and expands to lanes after
+                    # (ops/packed_slab.py:lane_one_hot)
+                    m = ps.lane_one_hot(ids, w, dtype=pvals.dtype)
+                    if m is not None:
+                        kw["mask"] = m
+                        kw["lane_width"] = w
+                slab = new_params[k]
+                st = (new_state[k] if isinstance(new_state, dict)
+                      else new_state)
+                slab, st = optimizer.apply_rows(slab, st, phys_ids, pvals,
+                                                lr, **kw)
+                new_params[k] = slab
+                if isinstance(new_state, dict):
+                    new_state[k] = st
         return new_params, new_state
 
     def sparse_apply_gradients(self, params: EmbedParams, opt_state, residuals,
@@ -1249,8 +1269,10 @@ class DistributedEmbedding:
             dead_shape=lambda g: (b, g.width),
             full_shape=(b, plan.s_max), dtype=out_dtype,
             axis=1)  # [world, b, s_max]
-        mp_grad = (lax.all_to_all(packed, self.axis_name, 0, 0, tiled=True)
-                   if world > 1 else packed)
+        with obs.scope("grad_all_to_all"):
+            mp_grad = (lax.all_to_all(packed, self.axis_name, 0, 0,
+                                      tiled=True)
+                       if world > 1 else packed)
 
         # Rank-uniform sparse update: per group, rebuild the id stream from
         # the forward's residual block and expand slot cotangents to per-id
@@ -1346,6 +1368,89 @@ class DistributedEmbedding:
 
         return self._apply_width_streams(params, opt_state, per_width,
                                          optimizer, lr, scale)
+
+    # --------------------------------------------------------- observability
+
+    def step_metrics(self, residuals, out_dtype=None) -> Dict[str, jax.Array]:
+        """On-device exchange/overflow metrics of one forward, derived from
+        the :meth:`forward_with_residuals` residuals — a handful of sums
+        over tensors the step already holds (near-zero cost), jit-safe.
+
+        Returns a plain dict (see :data:`~..utils.obs.STEP_METRIC_KEYS` for
+        the full step-metrics schema; the grad-norm/loss/step entries are
+        added by the trainer, which holds those values). Every entry is a
+        per-device ``[1]`` array so that under ``shard_map`` with
+        ``out_specs=P(axis_name)`` the rows concatenate into per-rank
+        ``[world]`` vectors:
+
+        * ``ids_routed`` — live (non-padding) ids this rank received
+          through the id exchange: the static dense-slot count plus the
+          dynamic ragged totals (claimed lengths clamped to capacity).
+        * ``id_overflow`` — ragged ids CLAIMED by the row lengths beyond
+          the slot's static capacity: every unit here is an id the lookup
+          silently dropped (the "ragged ids silently overflow ``CAP``"
+          failure made visible). Zero on healthy batches.
+        * ``id_a2a_bytes`` / ``out_a2a_bytes`` / ``grad_a2a_bytes`` —
+          bytes leaving this chip per step for the dp→mp id exchange, the
+          mp→dp activation exchange, and the reverse cotangent exchange
+          (static consequences of the plan layout, included so a metrics
+          record prices the padded exchange exactly like
+          ``bench.plan_exchange_bytes`` does).
+        * ``out_pad_frac`` — dead-column fraction of this rank's rows in
+          the output exchange (the placement-imbalance signal
+          ``comm_balanced`` minimizes).
+
+        Args:
+          residuals: second output of :meth:`forward_with_residuals`.
+          out_dtype: dtype of the exchanged activations (the trainer
+            passes the cotangent dtype); defaults to ``compute_dtype``
+            or float32.
+        """
+        _, ids_recv, encs, b = residuals
+        plan = self._get_plan(list(encs), b)
+        world = self.world_size
+        my = self._my_rank()
+        id_bytes = jnp.dtype(ids_recv.dtype).itemsize
+        out_bytes = jnp.dtype(out_dtype or self.compute_dtype
+                              or jnp.float32).itemsize
+
+        # static per-rank tallies baked from the plan (indexed by
+        # lax.axis_index like every other plan tensor)
+        dense_live = np.zeros((world, 1), np.int32)
+        live_cols = np.zeros((world, 1), np.int32)
+        for inst in plan.instances:
+            g = plan.groups[inst.group]
+            live_cols[inst.rank, 0] += plan.out_width(inst)
+            if g.kind == "d":
+                dense_live[inst.rank, 0] += world * b * inst.num_slots * g.hot
+        routed = self._plan_row(dense_live, my).astype(jnp.int32)
+        overflow = routed * 0  # zero that inherits routed's varying type
+        for gi, g in enumerate(plan.groups):
+            if g.kind == "d":
+                continue
+            region = lax.slice(ids_recv, (0, g.goff),
+                               (world, g.goff + g.n * g.blen))
+            lengths = region.reshape(world, g.n, g.blen)[:, :, g.hot:g.hot + b]
+            tot = jnp.sum(lengths, axis=2, dtype=jnp.int32)  # [world, n]
+            # dead slots carry zero lengths by construction (senders fill
+            # dead cells with zeros), so no valid-mask is needed here
+            routed = routed + jnp.sum(jnp.minimum(tot, g.hot)).reshape(1)
+            overflow = overflow + jnp.sum(
+                jnp.maximum(tot - g.hot, 0)).reshape(1)
+        off_chip = float(world - 1)
+        return {
+            "ids_routed": routed,
+            "id_overflow": overflow,
+            "id_a2a_bytes": self._vary(jnp.full(
+                (1,), off_chip * plan.l_max * id_bytes, jnp.float32)),
+            "out_a2a_bytes": self._vary(jnp.full(
+                (1,), off_chip * b * plan.s_max * out_bytes, jnp.float32)),
+            "grad_a2a_bytes": self._vary(jnp.full(
+                (1,), off_chip * b * plan.s_max * out_bytes, jnp.float32)),
+            "out_pad_frac": 1.0 - (
+                self._plan_row(live_cols, my).astype(jnp.float32)
+                / float(max(plan.s_max, 1))),
+        }
 
     # ------------------------------------------------------------- checkpoint
 

@@ -54,6 +54,7 @@ from flax import struct
 from jax import lax
 
 from ..ops.embedding_lookup import IdsLike, Ragged, SparseIds, embedding_lookup
+from ..utils import obs
 from .optimizers import _SORT_STREAM_MAX, _SORT_STREAM_MIN
 
 
@@ -92,6 +93,11 @@ def unique_ids_static(ids: jax.Array, vocab: int,
     (``cc/kernels/embedding_lookup_kernels.cu:499-515``)."""
     n = ids.shape[0]
     u = min(n, int(vocab) + 1) if max_unique is None else int(max_unique)
+    return _unique_ids_static(ids, int(vocab), n, u)
+
+
+@jax.named_scope("detpu/unique_ids")
+def _unique_ids_static(ids, vocab: int, n: int, u: int):
     # clamp BOTH ends BEFORE sorting. Above: ids > vocab would otherwise
     # sort past the pad slots (which hold exactly ``vocab``) and break the
     # ascending-uids property the scatters later declare; clamping merges
@@ -434,9 +440,39 @@ def apply_sparse_updates(params, updates):
 
     def one(p, u):
         if isinstance(u, SparseRows):
-            return p.at[u.ids].add(
-                u.rows.astype(p.dtype), mode="drop",
-                indices_are_sorted=_sorted_decl(u.ids.shape[0]))
+            with obs.scope("sparse_rows_apply"):
+                return p.at[u.ids].add(
+                    u.rows.astype(p.dtype), mode="drop",
+                    indices_are_sorted=_sorted_decl(u.ids.shape[0]))
         return p + u
     return jax.tree.map(one, params, updates,
                         is_leaf=lambda x: isinstance(x, SparseRows))
+
+
+def sparse_grad_metrics(sparse_grads: Sequence[SparseRows]):
+    """On-device observability of one sparse backward: per-table
+    touched-row counts and gradient norms, jit-safe and near-free
+    (the :mod:`~..utils.obs` layer's view into the sparse-optax pipeline).
+
+    Returns ``{"touched_rows": [T] int32, "sparse_grad_norm": [T] f32}``
+    aligned with ``sparse_grads`` — ``touched_rows`` counts the LIVE
+    entries (ids below the vocab; pad/out-of-range sentinel entries at
+    ``>= vocab`` excluded). :class:`SparseRows` built by
+    :func:`sparse_value_and_grad` / :func:`unique_ids_static` carry
+    sorted-unique ids, so there the live count IS the distinct-row count;
+    hand-built rows with repeated ids count each repeat.
+    ``sparse_grad_norm`` is the L2 norm of the live update rows. Log them
+    next to the step metrics to see skew (a table whose touched count
+    approaches its unique capacity every step is a dedup-win candidate; a
+    norm spike localizes divergence to a table).
+    """
+    with obs.scope("sparse_grad_metrics"):
+        touched, norms = [], []
+        for g in sparse_grads:
+            live = g.ids < g.vocab
+            touched.append(jnp.sum(live.astype(jnp.int32)))
+            rows = g.rows.astype(jnp.float32)
+            norms.append(jnp.sqrt(jnp.sum(
+                jnp.square(rows) * live[:, None].astype(rows.dtype))))
+        return {"touched_rows": jnp.stack(touched),
+                "sparse_grad_norm": jnp.stack(norms)}

@@ -24,43 +24,77 @@ import optax
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..utils import obs
 from .dist_embedding import DistributedEmbedding
 from .grads import resolve_dp_gradient
 
 
+def _metric_specs(axis_name: str):
+    """shard_map out_specs for the step-metrics dict: every ``[1]``
+    per-device entry concatenates into a ``[world]`` per-rank vector."""
+    return {k: P(axis_name) for k in obs.STEP_METRIC_KEYS}
+
+
+def _sq_sum(tree) -> jax.Array:
+    """Sum of squares over every leaf of a gradient pytree, in f32."""
+    return jax.tree.reduce(
+        lambda a, g: a + jnp.sum(jnp.square(g.astype(jnp.float32))),
+        tree, jnp.float32(0.0))
+
+
 def _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer, lr_schedule,
-                       state, cat_inputs, batch):
+                       state, cat_inputs, batch, with_metrics=False):
     """One per-device hybrid step (shared by :func:`make_hybrid_train_step`
     and :func:`make_hybrid_train_loop`): forward, one backward producing dp
     gradients (pmean-averaged) and mp cotangents (manual sparse path), both
-    optimizer updates, step counter bump."""
+    optimizer updates, step counter bump.
+
+    ``with_metrics=True`` (static, trace-time) additionally returns the
+    :data:`~..utils.obs.STEP_METRIC_KEYS` dict — the embedding layer's
+    exchange/overflow metrics plus loss, grad norms, and the step counter.
+    """
     world = de.world_size
     # slabs are {width: [world, rows, w]} globally -> [rows, w] per device
     emb_local = de.local_view(state.emb_params)
     emb_opt_local = de.local_view(state.emb_opt_state)
-    outs, res = de.forward_with_residuals(emb_local, cat_inputs)
+    with obs.scope("embedding_forward"):
+        outs, res = de.forward_with_residuals(emb_local, cat_inputs)
 
-    loss, (dense_grads, out_grads) = jax.value_and_grad(
-        loss_fn, argnums=(0, 1))(state.dense_params, outs, batch)
+    with obs.scope("dense_forward_backward"):
+        loss, (dense_grads, out_grads) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1))(state.dense_params, outs, batch)
     if world > 1:
         loss = lax.pmean(loss, de.axis_name)
         dense_grads = jax.tree.map(
             lambda g: resolve_dp_gradient(g, de.axis_name), dense_grads)
 
     lr = lr_schedule(state.step) if callable(lr_schedule) else lr_schedule
-    emb_local, emb_opt_local = de.sparse_apply_gradients(
-        emb_local, emb_opt_local, res, out_grads, emb_optimizer, lr)
+    with obs.scope("sparse_apply"):
+        emb_local, emb_opt_local = de.sparse_apply_gradients(
+            emb_local, emb_opt_local, res, out_grads, emb_optimizer, lr)
 
-    updates, dense_opt_state = dense_tx.update(
-        dense_grads, state.dense_opt_state, state.dense_params)
-    dense_params = optax.apply_updates(state.dense_params, updates)
+    with obs.scope("dense_update"):
+        updates, dense_opt_state = dense_tx.update(
+            dense_grads, state.dense_opt_state, state.dense_params)
+        dense_params = optax.apply_updates(state.dense_params, updates)
 
     new_state = HybridTrainState(
         emb_params=de.stacked_view(emb_local),
         emb_opt_state=de.stacked_view(emb_opt_local),
         dense_params=dense_params, dense_opt_state=dense_opt_state,
         step=state.step + 1)
-    return loss, new_state
+    if not with_metrics:
+        return loss, new_state
+    metrics = de.step_metrics(
+        res, out_dtype=out_grads[0].dtype if out_grads else None)
+    # out_grads are device-varying; the pmean'd loss / resolved dense
+    # grads / replicated step are not — _vary marks them for P(axis) out
+    metrics["emb_grad_norm"] = jnp.sqrt(_sq_sum(out_grads)).reshape(1)
+    metrics["dense_grad_norm"] = de._vary(
+        jnp.sqrt(_sq_sum(dense_grads)).reshape(1))
+    metrics["loss"] = de._vary(loss.astype(jnp.float32).reshape(1))
+    metrics["step"] = de._vary(state.step.astype(jnp.int32).reshape(1))
+    return loss, new_state, metrics
 
 
 class HybridTrainState(NamedTuple):
@@ -80,7 +114,8 @@ def make_hybrid_train_step(de: DistributedEmbedding,
                            dense_tx: optax.GradientTransformation,
                            emb_optimizer,
                            mesh=None,
-                           lr_schedule=1.0):
+                           lr_schedule=1.0,
+                           with_metrics: Optional[bool] = None):
     """Build ``step(state, cat_inputs, batch) -> (loss, state)``.
 
     Args:
@@ -94,16 +129,26 @@ def make_hybrid_train_step(de: DistributedEmbedding,
       lr_schedule: embedding-optimizer learning rate — a constant or a
         ``step -> lr`` callable (the dense side can use optax schedules
         natively).
+      with_metrics: instrument the step with on-device observability
+        metrics — the step then returns ``(loss, state, metrics)`` where
+        ``metrics`` is the :data:`~..utils.obs.STEP_METRIC_KEYS` dict of
+        per-rank ``[world]`` vectors (exchange bytes, routed-id counts,
+        ragged-overflow counters, grad norms). ``None`` (default) follows
+        ``DETPU_OBS=1``, so an uninstrumented run keeps the 2-tuple
+        signature and pays nothing.
 
     The returned step takes data-parallel shards: each categorical input
     ``[local_batch, hotness]`` and ``batch`` any pytree of per-device arrays
     the loss consumes (already sharded by the caller).
     """
     world = de.world_size
+    if with_metrics is None:
+        with_metrics = obs.metrics_enabled()
 
     def local_step(state: HybridTrainState, cat_inputs, batch):
         return _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer,
-                                  lr_schedule, state, cat_inputs, batch)
+                                  lr_schedule, state, cat_inputs, batch,
+                                  with_metrics=with_metrics)
 
     if world == 1:
         return jax.jit(local_step, donate_argnums=(0,))
@@ -114,11 +159,13 @@ def make_hybrid_train_step(de: DistributedEmbedding,
     state_specs = HybridTrainState(
         emb_params=P(ax), emb_opt_state=P(ax),
         dense_params=P(), dense_opt_state=P(), step=P())
+    out_specs = ((P(), state_specs, _metric_specs(ax)) if with_metrics
+                 else (P(), state_specs))
 
     sm = jax.shard_map(
         local_step, mesh=mesh,
         in_specs=(state_specs, P(ax), P(ax)),
-        out_specs=(P(), state_specs))
+        out_specs=out_specs)
     return jax.jit(sm, donate_argnums=(0,))
 
 
@@ -128,10 +175,16 @@ def make_hybrid_train_loop(de: DistributedEmbedding,
                            emb_optimizer,
                            mesh=None,
                            lr_schedule=1.0,
-                           unroll: int = 1):
+                           unroll: int = 1,
+                           with_metrics: Optional[bool] = None):
     """Multi-step training driver: ``loop(state, cat_stacks, batch_stacks)
     -> (losses [K], state)`` running K steps inside ONE compiled program via
     ``lax.scan``.
+
+    ``with_metrics`` (default: follow ``DETPU_OBS=1``) instruments every
+    scanned step like :func:`make_hybrid_train_step`: the loop then
+    returns ``(losses [K], state, metrics)`` with each metrics entry
+    stacked ``[K, world]`` (one row per scanned step).
 
     Per-step host dispatch costs real wall-clock (through this repo's
     benchmark tunnel it measured ~25 ms/step — 25% of the DLRM headline
@@ -144,20 +197,32 @@ def make_hybrid_train_loop(de: DistributedEmbedding,
     exactly :func:`make_hybrid_train_step`'s — same ``local_step`` body.
     """
     world = de.world_size
+    if with_metrics is None:
+        with_metrics = obs.metrics_enabled()
 
     def body(state, xs):
         cat_inputs, batch = xs
-        loss, state = _hybrid_local_step(
+        out = _hybrid_local_step(
             de, loss_fn, dense_tx, emb_optimizer, lr_schedule, state,
-            cat_inputs, batch)
+            cat_inputs, batch, with_metrics=with_metrics)
+        if with_metrics:
+            loss, state, metrics = out
+            return state, (loss, metrics)
+        loss, state = out
         return state, loss
 
+    def local_loop(state, cat_stacks, batch_stacks):
+        # shared by world == 1 and shard_map (_hybrid_local_step already
+        # pmeans the loss and resolves dp gradients for world > 1)
+        state, ys = lax.scan(body, state, (cat_stacks, batch_stacks),
+                             unroll=unroll)
+        if with_metrics:
+            losses, metrics = ys  # metrics leaves stacked [K, 1]
+            return losses, state, metrics
+        return ys, state
+
     if world == 1:
-        def loop(state, cat_stacks, batch_stacks):
-            state, losses = lax.scan(body, state, (cat_stacks, batch_stacks),
-                                     unroll=unroll)
-            return losses, state
-        return jax.jit(loop, donate_argnums=(0,))
+        return jax.jit(local_loop, donate_argnums=(0,))
 
     if mesh is None:
         raise ValueError("mesh is required for world_size > 1")
@@ -165,18 +230,14 @@ def make_hybrid_train_loop(de: DistributedEmbedding,
     state_specs = HybridTrainState(
         emb_params=P(ax), emb_opt_state=P(ax),
         dense_params=P(), dense_opt_state=P(), step=P())
-
-    def local_loop(state, cat_stacks, batch_stacks):
-        # same body as world == 1 (_hybrid_local_step already pmeans the
-        # loss and resolves dp gradients for world > 1)
-        state, losses = lax.scan(body, state, (cat_stacks, batch_stacks),
-                                 unroll=unroll)
-        return losses, state
+    out_specs = ((P(), state_specs,
+                  {k: P(None, ax) for k in obs.STEP_METRIC_KEYS})
+                 if with_metrics else (P(), state_specs))
 
     sm = jax.shard_map(
         local_loop, mesh=mesh,
         in_specs=(state_specs, P(None, ax), P(None, ax)),
-        out_specs=(P(), state_specs))
+        out_specs=out_specs)
     return jax.jit(sm, donate_argnums=(0,))
 
 

@@ -1,11 +1,16 @@
-"""Data pipelines, metrics, checkpointing, and the fault-tolerant runtime
-layer for the example models and entry points."""
+"""Data pipelines, metrics, checkpointing, the fault-tolerant runtime
+layer, and the step-level observability layer for the example models and
+entry points."""
 
-from . import runtime
+from . import obs, runtime
 from .checkpoint import (previous_checkpoint_path, restore_train_state,
                          save_train_state, verify_checkpoint)
 from .data import DummyDataset, RawBinaryDataset, power_law_ids
 from .metrics import binary_auc
+from .obs import (MetricsLogger, StepTimer, counter_inc, counters,
+                  fetch_metrics, install_compile_listener,
+                  maybe_start_server, metrics_enabled, profile_trace,
+                  reset_counters, scope)
 from .runtime import (BackendProbe, BackendUnavailable, CheckpointCorrupt,
                       CoordinatorUnreachable, DeadlineExceeded, DeviceSpec,
                       FaultInjected, SectionRecorder, deadline, fault_point,

@@ -60,15 +60,20 @@ import logging
 import os
 import shutil
 import zlib
-from typing import Any, Callable, Dict, Optional
+from typing import TYPE_CHECKING, Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import serialization
 
-from ..parallel.trainer import HybridTrainState
 from . import runtime
+
+if TYPE_CHECKING:  # function-local at run time: a module-scope import of
+    # parallel.trainer from here would close an import cycle the moment a
+    # parallel module imports utils.obs (utils/__init__ -> checkpoint ->
+    # parallel -> dist_embedding -> utils, mid-initialization)
+    from ..parallel.trainer import HybridTrainState
 
 logger = logging.getLogger(__name__)
 
@@ -421,6 +426,8 @@ def restore_train_state(path: str, de, emb_optimizer, dense_template,
              "step": jnp.zeros((), jnp.int32)}
     with open(os.path.join(path, "dense.msgpack"), "rb") as f:
         dense = serialization.from_bytes(dense, f.read())
+    from ..parallel.trainer import HybridTrainState
+
     return HybridTrainState(
         emb_params=emb_params, emb_opt_state=opt_state,
         dense_params=dense["dense_params"],

@@ -1,0 +1,363 @@
+"""Step-level observability: named-scope tracing, on-device step metrics,
+process counters, and a crash-surviving metrics sidecar.
+
+PR 1's runtime layer (:mod:`.runtime`) made failures *survivable* — a
+stalled tunnel or a killed process leaves parseable records. This module
+makes runs *explainable*: when throughput drops, or ragged ids silently
+overflow their static capacity, there is something to look at. Every later
+perf PR is measured against the instrumentation here.
+
+Three layers, all off by default and <1% overhead when disabled:
+
+* **Named-scope tracing** — :func:`scope` wraps the hybrid step's phases
+  (id all-to-all, per-width lookups, ragged decode, output exchange,
+  sparse apply) in ``jax.named_scope`` so a captured XLA profile
+  attributes device time to phases instead of one opaque jit blob.
+  Scopes are trace-time-only metadata: they cost nothing at run time and
+  are therefore always on. :func:`profile_trace` (gated by
+  ``DETPU_PROFILE_DIR``) and :func:`maybe_start_server` (gated by
+  ``DETPU_PROFILE_PORT``) capture the profiles the scopes annotate.
+* **On-device step metrics** — a plain-dict pytree (keys
+  :data:`STEP_METRIC_KEYS`) computed *inside* the jitted step by
+  ``DistributedEmbedding.step_metrics`` + ``trainer.make_hybrid_train_step
+  (with_metrics=True)``: ids routed per rank, exchange bytes per
+  direction, ragged capacity-overflow counts, output-exchange padding
+  fraction, dense/embedding grad norms. A handful of sums over tensors the
+  step already holds — near-zero cost, and only built when
+  ``DETPU_OBS=1`` (or ``with_metrics=True`` is passed explicitly).
+* **Host-side collection** — :class:`MetricsLogger` drains step-metric
+  pytrees into an fsynced JSONL sidecar (same crash-surviving mechanics as
+  :class:`.runtime.SectionRecorder`, which it rides), and module-level
+  :func:`counter_inc`/:func:`counters` track process events: recompiles
+  (:func:`install_compile_listener`, a ``jax.monitoring`` backend-compile
+  listener), runtime retries, fault injections, bootstrap retries.
+
+Like :mod:`.runtime`, this module never imports jax at module scope:
+importing it must never risk touching an accelerator backend, and the
+counter/logger half works in processes that never load jax at all.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, Iterator, Optional
+
+from . import runtime as _runtime
+
+logger = logging.getLogger(__name__)
+
+OBS_ENV = "DETPU_OBS"
+PROFILE_DIR_ENV = "DETPU_PROFILE_DIR"
+PROFILE_PORT_ENV = "DETPU_PROFILE_PORT"
+
+#: Keys of the on-device step-metrics dict (a plain dict so it is a pytree
+#: without any registration, and JSON-serializable after a host fetch).
+#: Every value is a per-device ``[1]``-shaped array; under ``shard_map``
+#: with ``out_specs=P(axis)`` the per-device rows concatenate into a
+#: ``[world]`` per-rank vector (rank ``r``'s entry describes rank ``r``).
+STEP_METRIC_KEYS = (
+    "ids_routed",        # live (non-padding) ids this rank received
+    "id_overflow",       # ragged ids lost to static-capacity truncation
+    "id_a2a_bytes",      # id-exchange bytes leaving this chip (dp->mp)
+    "out_a2a_bytes",     # activation-exchange bytes leaving (mp->dp fwd)
+    "grad_a2a_bytes",    # cotangent-exchange bytes leaving (dp->mp bwd)
+    "out_pad_frac",      # dead-column fraction of this rank's output rows
+    "loss",              # per-device loss (post-pmean: identical rows)
+    "emb_grad_norm",     # L2 norm of this device's embedding cotangents
+    "dense_grad_norm",   # L2 norm of the (averaged) dense gradient
+    "step",              # step counter at the START of the step
+)
+
+
+def metrics_enabled() -> bool:
+    """Whether ``DETPU_OBS`` asks for step metrics (read per call so tests
+    can flip it at runtime; an env read is nanoseconds against a train
+    step)."""
+    return os.environ.get(OBS_ENV, "") not in ("", "0")
+
+
+# ------------------------------------------------------------- named scopes
+
+
+def scope(name: str):
+    """``jax.named_scope("detpu/<name>")`` — phase attribution for XLA
+    profiles. Trace-time-only metadata (zero run-time cost), so call sites
+    use it unconditionally."""
+    import jax
+
+    return jax.named_scope(f"detpu/{name}")
+
+
+@contextlib.contextmanager
+def profile_trace(label: Optional[str] = None) -> Iterator[None]:
+    """Capture an XLA profile of the enclosed block into
+    ``$DETPU_PROFILE_DIR`` (a TensorBoard-loadable trace directory); a
+    transparent no-op when the variable is unset.
+
+    ``label`` names a subdirectory so successive captures (e.g. one per
+    bench section) do not overwrite each other.
+    """
+    base = os.environ.get(PROFILE_DIR_ENV)
+    if not base:
+        yield
+        return
+    import jax
+
+    path = os.path.join(base, label) if label else base
+    os.makedirs(path, exist_ok=True)
+    with jax.profiler.trace(path):
+        yield
+
+
+_server_started = False
+_server_lock = threading.Lock()
+
+
+def maybe_start_server() -> bool:
+    """Start ``jax.profiler.start_server($DETPU_PROFILE_PORT)`` once per
+    process (for live TensorBoard capture); no-op without the variable.
+    Returns whether a server is running after the call."""
+    global _server_started
+    port = os.environ.get(PROFILE_PORT_ENV)
+    if not port:
+        return _server_started
+    with _server_lock:
+        if not _server_started:
+            import jax
+
+            jax.profiler.start_server(int(port))
+            _server_started = True
+            logger.info("obs: profiler server listening on port %s", port)
+    return _server_started
+
+
+# -------------------------------------------------------- process counters
+
+_counters: Dict[str, int] = {}
+_counters_lock = threading.Lock()
+
+
+def counter_inc(name: str, n: int = 1) -> int:
+    """Bump a process-level counter (``recompiles``, ``runtime_retries``,
+    ``fault_injections``, ``bootstrap_retries``, ...); returns the new
+    value. Thread-safe; always on (a dict bump is free)."""
+    with _counters_lock:
+        v = _counters.get(name, 0) + n
+        _counters[name] = v
+    return v
+
+
+def counters() -> Dict[str, int]:
+    """Snapshot of every process counter."""
+    with _counters_lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    """Forget counter state (test isolation helper)."""
+    with _counters_lock:
+        _counters.clear()
+
+
+_compile_listener_installed = False
+
+# one backend compile per jitted-signature miss: cache hits do not fire it
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def install_compile_listener() -> bool:
+    """Count XLA recompiles into the ``recompiles`` counter.
+
+    Registers a ``jax.monitoring`` duration listener for the
+    backend-compile event, which fires exactly once per compiled
+    executable (jit cache hits do not emit it) — the cache-miss signal
+    that distinguishes "throughput fell because something retraces every
+    step" from a genuine regression. Idempotent; returns False when the
+    running jax has no monitoring hooks (the caller loses the counter,
+    nothing else).
+    """
+    global _compile_listener_installed
+    if _compile_listener_installed:
+        return True
+    try:
+        import jax.monitoring
+    except Exception:  # noqa: BLE001 - counter is best-effort
+        return False
+    if not hasattr(jax.monitoring, "register_event_duration_secs_listener"):
+        return False
+
+    def _on_duration(event: str, duration: float, **kwargs: Any) -> None:
+        del duration, kwargs
+        if event == _COMPILE_EVENT:
+            counter_inc("recompiles")
+
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    _compile_listener_installed = True
+    return True
+
+
+# --------------------------------------------------------- host collection
+
+
+class MetricsLogger:
+    """Fsynced JSONL sidecar of step metrics and counters.
+
+    Rides :class:`.runtime.SectionRecorder` (append one JSON line, flush,
+    fsync), so a process killed at any point leaves every previously
+    logged record parseable — the property that made ``BENCH.partial.jsonl``
+    survive rc=124. Records:
+
+    * ``{"section": "step_metrics", "step": N, "metrics": {...}, ...}``
+      from :meth:`log_step` — device arrays are fetched and listified
+      (``[world]``-shaped per-rank vectors stay vectors);
+    * ``{"section": "counters", "counters": {...}}`` from
+      :meth:`log_counters` — the process counters, recompiles included.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._rec = _runtime.SectionRecorder(path)
+
+    def log_step(self, metrics: Dict[str, Any], step: Optional[int] = None,
+                 **extra: Any) -> Dict[str, Any]:
+        """Append one step-metrics record. ``metrics`` is the dict the
+        instrumented train step returned (device arrays or numpy); fetching
+        the values here is the ONE host readback the caller opted into by
+        logging."""
+        host = {}
+        for k, v in metrics.items():
+            host[k] = v.tolist() if hasattr(v, "tolist") else v
+        rec = dict(extra)
+        if step is not None:
+            rec["step"] = int(step)
+        return self._rec.record("step_metrics", metrics=host, **rec)
+
+    def log_counters(self, **extra: Any) -> Dict[str, Any]:
+        """Append the current process-counter snapshot."""
+        return self._rec.record("counters", counters=counters(), **extra)
+
+    @staticmethod
+    def load(path: str):
+        """Parse a metrics sidecar (torn trailing line tolerated)."""
+        return _runtime.SectionRecorder.load(path)
+
+
+def fetch_metrics(metrics: Dict[str, Any]) -> Dict[str, Any]:
+    """Host numpy copy of a step-metrics dict, multi-host safe.
+
+    Under ``shard_map`` with ``out_specs=P(axis)`` on a pod, each
+    ``[world]`` metrics vector spans devices of EVERY process — a bare
+    ``tolist()`` on one process raises (non-addressable shards). This
+    gathers such arrays with ``process_allgather``, which is a
+    COLLECTIVE: on a multi-process job every process must call
+    :func:`fetch_metrics` (even the ones that then drop the result), and
+    only the chief hands it to :class:`MetricsLogger`. Single-process:
+    a plain device fetch.
+    """
+    import numpy as np
+
+    out: Dict[str, Any] = {}
+    for k, v in metrics.items():
+        if getattr(v, "is_fully_addressable", True):
+            out[k] = np.asarray(v) if hasattr(v, "tolist") else v
+        else:
+            from jax.experimental import multihost_utils
+
+            out[k] = np.asarray(
+                multihost_utils.process_allgather(v, tiled=True))
+    return out
+
+
+def summarize(metrics: Dict[str, Any]) -> Dict[str, Any]:
+    """Host-side scalar summary of one step-metrics dict: per-rank vectors
+    reduce to totals (sums for counts/bytes, max for overflow — the rank
+    that truncated is the one to look at), norms/fractions to their max."""
+    import numpy as np
+
+    out: Dict[str, Any] = {}
+    for k in STEP_METRIC_KEYS:
+        if k not in metrics:
+            continue
+        v = np.asarray(metrics[k]).reshape(-1)
+        if v.size == 0:
+            continue
+        if k in ("ids_routed", "id_a2a_bytes", "out_a2a_bytes",
+                 "grad_a2a_bytes"):
+            out[k] = float(v.sum())
+        elif k in ("id_overflow", "out_pad_frac", "emb_grad_norm"):
+            out[k] = float(v.max())
+        else:
+            out[k] = float(v[0])
+    return out
+
+
+def record_fault(point: str) -> None:
+    """Counter hook for :func:`.runtime.fault_point` — one bump per fired
+    injection, keyed globally and per point."""
+    counter_inc("fault_injections")
+    counter_inc(f"fault_injections.{point}")
+
+
+def record_retry(describe: str) -> None:
+    """Counter hook for :func:`.runtime.retry` — one bump per retried
+    attempt (the success that needed no retry bumps nothing)."""
+    counter_inc("runtime_retries")
+    counter_inc(f"runtime_retries.{describe.replace(' ', '_')}")
+
+
+class StepTimer:
+    """Tiny host-side wall-clock phase accumulator for loops that want
+    coarse (non-XLA) timing next to the on-device metrics: ``with
+    timer.section("eval"): ...``; :meth:`totals` returns seconds per
+    label. Not a profiler — the XLA trace is — just enough to see where a
+    *host* loop spends its time."""
+
+    def __init__(self):
+        self._totals: Dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def section(self, label: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._totals[label] = (self._totals.get(label, 0.0)
+                                   + time.perf_counter() - t0)
+
+    def totals(self) -> Dict[str, float]:
+        return dict(self._totals)
+
+
+def env_stamp() -> Dict[str, Any]:
+    """Process/environment identity for stamping benchmark records:
+    backend platform + device count are NOT probed here (that is the
+    caller's time-boxed :func:`.runtime.probe_backend` verdict, passed
+    in); this returns what is knowable without touching a backend."""
+    stamp: Dict[str, Any] = {
+        "unix_time": time.time(),
+        "obs_enabled": metrics_enabled(),
+    }
+    try:
+        import jax
+
+        stamp["jax_version"] = jax.__version__
+    except Exception:  # noqa: BLE001 - stamp is best-effort
+        stamp["jax_version"] = None
+    return stamp
+
+
+def _selftest_json_roundtrip(metrics: Dict[str, Any]) -> bool:
+    """Whether a metrics dict survives a json round trip after host
+    fetch — used by the verify gate to fail fast on an unserializable
+    field sneaking into :data:`STEP_METRIC_KEYS` payloads."""
+    try:
+        json.dumps({k: (v.tolist() if hasattr(v, "tolist") else v)
+                    for k, v in metrics.items()})
+        return True
+    except (TypeError, ValueError):
+        return False

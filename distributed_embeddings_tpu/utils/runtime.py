@@ -145,6 +145,9 @@ def fault_point(point: str) -> None:
         if mode == "raise" and arg is not None and n >= int(arg):
             continue  # budget exhausted: the point now passes
         _fire_counts[key] = n + 1
+        from . import obs  # lazy: obs imports this module at its top
+
+        obs.record_fault(point)
         if mode == "hang":
             time.sleep(float(arg) if arg else 3600.0)
         elif mode == "slow":
@@ -197,6 +200,9 @@ def retry(fn: Callable[[], Any], *,
                         f"{e!r}") from e
             logger.warning("%s failed (attempt %d): %r — retrying in %.2fs",
                            describe, attempt, e, delay)
+            from . import obs  # lazy: obs imports this module at its top
+
+            obs.record_retry(describe)
             time.sleep(delay)
 
 

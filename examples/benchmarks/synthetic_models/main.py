@@ -70,7 +70,8 @@ def main(_):
     state = init_hybrid_state(de, emb_opt, dense_params, tx,
                               jax.random.key(1), mesh=mesh)
     step_fn = make_hybrid_train_step(de, loss_fn, tx, emb_opt, mesh=mesh,
-                                     lr_schedule=FLAGS.learning_rate)
+                                     lr_schedule=FLAGS.learning_rate,
+                                     with_metrics=False)
 
     # compile + warmup; float() readback drains the pipeline — on remote
     # tunnels block_until_ready can be a no-op (docs/perf_tpu.md Methodology)

@@ -41,7 +41,7 @@ from distributed_embeddings_tpu.parallel import (
     DistributedEmbedding, SparseSGD, bootstrap, init_hybrid_state,
     make_hybrid_eval_step, make_hybrid_train_step)
 from distributed_embeddings_tpu.utils import (
-    RawBinaryDataset, binary_auc, power_law_ids)
+    RawBinaryDataset, binary_auc, obs, power_law_ids)
 
 FLAGS = flags.FLAGS
 flags.DEFINE_string("dataset_path", None,
@@ -93,6 +93,13 @@ flags.DEFINE_float("bootstrap_timeout_s", None,
 flags.DEFINE_integer("bootstrap_retries", 2,
                      "join retry budget before a cluster-expected job "
                      "fails with CoordinatorUnreachable")
+flags.DEFINE_string("metrics_out", None,
+                    "step-metrics JSONL sidecar path (observability layer); "
+                    "default <checkpoint_out>.metrics.jsonl when DETPU_OBS=1 "
+                    "is set, disabled otherwise")
+flags.DEFINE_integer("metrics_interval", 100,
+                     "log a step-metrics record every N training steps "
+                     "(only when metrics are enabled)")
 
 
 def synthetic_batches(cfg, num_batches, batch_size, seed=0):
@@ -116,6 +123,18 @@ def main(_):
     bootstrap.initialize(timeout_s=FLAGS.bootstrap_timeout_s,
                          retries=FLAGS.bootstrap_retries)
     is_chief = bootstrap.process_index() == 0
+
+    # observability (all off unless the env/flags ask): live-profiler
+    # server, recompile counter, step-metrics sidecar
+    obs.maybe_start_server()
+    with_metrics = obs.metrics_enabled() or FLAGS.metrics_out is not None
+    metrics_log = None
+    if with_metrics:
+        obs.install_compile_listener()
+        if is_chief:
+            metrics_log = obs.MetricsLogger(
+                FLAGS.metrics_out
+                or FLAGS.checkpoint_out + ".metrics.jsonl")
 
     table_sizes = [int(s) for s in FLAGS.table_sizes]
     if FLAGS.dataset_path is not None:
@@ -174,7 +193,8 @@ def main(_):
         state = init_hybrid_state(de, emb_opt, dense_params, tx,
                                   jax.random.key(1), mesh=mesh)
     step_fn = make_hybrid_train_step(de, loss_fn, tx, emb_opt, mesh=mesh,
-                                     lr_schedule=sched)
+                                     lr_schedule=sched,
+                                     with_metrics=with_metrics)
 
     nproc = bootstrap.process_count()
     pid = bootstrap.process_index()
@@ -267,7 +287,19 @@ def main(_):
     # uninterrupted run
     for step, (num, cats, labels) in enumerate(train_iter,
                                                start=int(state.step)):
-        loss, state = step_fn(state, prep_cats(cats), prep_batch(num, labels))
+        if with_metrics:
+            loss, state, metrics = step_fn(state, prep_cats(cats),
+                                           prep_batch(num, labels))
+            if step % FLAGS.metrics_interval == 0:
+                # fetch_metrics is a COLLECTIVE on a pod (the [world]
+                # vectors span every process's devices): every process
+                # calls it, only the chief logs the fsynced record
+                host_metrics = obs.fetch_metrics(metrics)
+                if metrics_log is not None:
+                    metrics_log.log_step(host_metrics, step=step)
+        else:
+            loss, state = step_fn(state, prep_cats(cats),
+                                  prep_batch(num, labels))
         if step % 1000 == 0 and is_chief:
             print("step:", step, " loss:", float(loss))
         if (FLAGS.eval_interval and eval_data is not None and step
@@ -298,6 +330,10 @@ def main(_):
         save_train_state(FLAGS.save_state, de, state)
         if is_chief:
             print("saved full train state to", FLAGS.save_state)
+    if metrics_log is not None:
+        # final process-counter snapshot: recompiles, runtime retries,
+        # fault injections — the "why was this run slow/odd" record
+        metrics_log.log_counters(final=True)
 
 
 if __name__ == "__main__":

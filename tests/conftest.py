@@ -15,6 +15,12 @@ import os
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
+# a user-level DETPU_OBS=1 would flip every env-defaulted train step to the
+# instrumented 3-tuple return and break the suite's 2-tuple call sites —
+# the suite opts in explicitly (with_metrics=True) where it tests metrics.
+# Popped here (before any test imports), so subprocess tests inherit the
+# sanitized environment too.
+os.environ.pop("DETPU_OBS", None)
 
 import jax
 

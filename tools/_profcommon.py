@@ -1,0 +1,115 @@
+"""Shared setup for the ``tools/profile_*.py`` microbenchmarks.
+
+Every profile tool used to open with the same boilerplate: a ``sys.path``
+insert, the capped Criteo-Kaggle vocab table, and copies of the
+readback-forced repetition-slope timing helpers (``docs/perf_tpu.md``
+"Methodology") — and, critically, a bare first backend touch. The latter
+is the exact bug that motivated PR 1: a stalled device tunnel turns the
+first ``jit`` dispatch into a silent multi-minute hang. :func:`ensure_backend`
+routes every tool through ``utils.runtime.probe_backend`` (a watched
+subprocess with a hard timeout) so a dead backend fails in seconds with a
+clear message instead.
+
+Usage, at the top of a tool::
+
+    import _profcommon as pc
+    ...
+    if __name__ == "__main__":
+        pc.ensure_backend()   # probe-first; exits 2 if unavailable
+        main(sys.argv[1:])
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# the bench's capped Criteo-Kaggle vocabs — the shapes every profile tool
+# times (kept here so the five tools cannot drift apart)
+CAP = 2_000_000
+CRITEO_KAGGLE_SIZES = [
+    1460, 583, 10131227, 2202608, 305, 24, 12517, 633, 3, 93145, 5683,
+    8351593, 3194, 27, 14992, 5461306, 10, 5652, 2173, 4, 7046547, 18, 15,
+    286181, 105, 142572,
+]
+CAP_SIZES = [min(s, CAP) for s in CRITEO_KAGGLE_SIZES]
+
+
+def ensure_backend(timeout_s: float | None = None):
+    """Probe the backend BEFORE this process's first jax touch.
+
+    Runs ``utils.runtime.probe_backend`` (subprocess + hard timeout, the
+    PR 1 mechanism) and exits 2 with a readable message when the backend
+    is unavailable — a profile tool must never hang on a stalled tunnel.
+    On success also arms the observability hooks (recompile counter,
+    ``DETPU_PROFILE_PORT`` server) so captured profiles carry the named
+    scopes this repo's step is annotated with. Returns the
+    ``BackendProbe``.
+    """
+    from distributed_embeddings_tpu.utils import obs, runtime
+
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("DETPU_PROBE_TIMEOUT_S", "120"))
+    probe = runtime.probe_backend(timeout_s=timeout_s)
+    if not probe.ok:
+        print(f"profile tool: backend unavailable ({probe.error}); "
+              "fix the tunnel or set JAX_PLATFORMS=cpu to profile the CPU "
+              "lowering", file=sys.stderr)
+        sys.exit(2)
+    print(f"backend: {probe.platform} x{probe.device_count} "
+          f"(probed in {probe.elapsed_s:.1f}s)", flush=True)
+    obs.install_compile_listener()
+    obs.maybe_start_server()
+    return probe
+
+
+def readback(x) -> float:
+    """Force completion through the device tunnel with a one-element host
+    fetch (``block_until_ready`` can be a no-op through remote tunnels —
+    ``docs/perf_tpu.md``)."""
+    import jax.numpy as jnp
+
+    return float(jnp.asarray(x).reshape(-1)[0])
+
+
+def slope(make_fn, args, iters_hi: int = 3) -> float:
+    """Repetition-slope timing in ms: jit ``make_fn(1)`` and
+    ``make_fn(iters_hi)`` (K in-jit repetitions of the phase under test),
+    time both after compile, report the per-repetition slope — dispatch
+    constants and readback cost cancel."""
+    import jax
+
+    f1 = jax.jit(make_fn(1))
+    fh = jax.jit(make_fn(iters_hi))
+    readback(f1(*args))  # compile
+    readback(fh(*args))
+    t0 = time.perf_counter(); readback(f1(*args)); t1 = time.perf_counter()
+    readback(fh(*args)); t2 = time.perf_counter()
+    return ((t2 - t1) - (t1 - t0)) / (iters_hi - 1) * 1e3
+
+
+def slope_donate(make_fn, args, iters_hi: int = 3) -> float:
+    """:func:`slope` with the FIRST argument donated and re-threaded
+    between calls — for phases that update a multi-GB slab in place
+    (without donation XLA copies the slab and the program OOMs). The
+    ``make_fn(k)`` body must return ``(scalar, slab)``."""
+    import jax
+
+    f1 = jax.jit(make_fn(1), donate_argnums=(0,))
+    fh = jax.jit(make_fn(iters_hi), donate_argnums=(0,))
+    state = {"args": args}
+
+    def run(f):
+        s, sl = f(*state["args"])
+        state["args"] = (sl,) + state["args"][1:]
+        return readback(s)
+
+    run(f1); run(fh)
+    t0 = time.perf_counter(); run(f1); t1 = time.perf_counter()
+    run(fh); t2 = time.perf_counter()
+    return ((t2 - t1) - (t1 - t0)) / (iters_hi - 1) * 1e3

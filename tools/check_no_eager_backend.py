@@ -8,7 +8,9 @@ were rewired to decide purely from ``utils.runtime.probe_backend`` (a
 watched subprocess with a timeout); this check keeps the bare calls from
 creeping back in.
 
-Rules, per checked file (``__graft_entry__.py``, ``bench.py``):
+Rules, per checked file (``__graft_entry__.py``, ``bench.py``, and — since
+the observability PR routed them through ``probe_backend`` — every
+``tools/*.py``):
 
 * a backend-touching call (``jax.devices``, ``jax.device_count``,
   ``jax.local_devices``, ``jax.local_device_count``,
@@ -32,6 +34,14 @@ BACKEND_ATTRS = {"devices", "device_count", "local_devices",
 MARKER = "backend-ok:"
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CHECKED_FILES = ("__graft_entry__.py", "bench.py")
+
+
+def _tool_files():
+    """Every ``tools/*.py`` (this checker included — it holds itself to
+    its own rule; trivially, since it never imports jax)."""
+    d = os.path.join(REPO, "tools")
+    return tuple(os.path.join("tools", name) for name in sorted(
+        os.listdir(d)) if name.endswith(".py"))
 
 
 def _is_backend_call(node: ast.Call) -> bool:
@@ -73,7 +83,8 @@ def check_file(path: str) -> list:
 
 def main() -> int:
     errors = []
-    for name in CHECKED_FILES:
+    checked = CHECKED_FILES + _tool_files()
+    for name in checked:
         path = os.path.join(REPO, name)
         if not os.path.exists(path):
             errors.append(f"{name}: checked file missing")
@@ -82,8 +93,8 @@ def main() -> int:
     for e in errors:
         print(f"check_no_eager_backend: {e}", file=sys.stderr)
     if not errors:
-        print("check_no_eager_backend: OK "
-              f"({', '.join(CHECKED_FILES)} clean)")
+        print(f"check_no_eager_backend: OK ({len(checked)} files clean: "
+              f"{', '.join(CHECKED_FILES)} + tools/*.py)")
     return 1 if errors else 0
 
 

@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""Diff two BENCH records and fail on throughput regressions.
+
+The repo accumulates one BENCH JSON per round (``BENCH_r*.json``), but
+nothing ever *compared* them — a 15% throughput slide between rounds was
+only caught by a human reading numbers. This tool is the regression gate:
+
+    python tools/compare_bench.py OLD.json NEW.json [--threshold 0.10]
+
+Accepts either the driver's wrapper format (``{"rc": ..., "parsed":
+{...}}``) or bench.py's raw one-line JSON. Exit codes:
+
+* 0 — every comparable metric within the threshold;
+* 1 — at least one regression beyond the threshold (throughput metrics
+  dropping, or ms-per-iter metrics rising, by more than ``--threshold``,
+  default 10%);
+* 2 — unusable inputs (missing file, no parseable payload).
+
+Metrics present in only one record are reported but never fail the gate
+(rounds legitimately add sections). When both records carry the PR 2
+``env`` stamp (backend, device count, jax version), a mismatch is printed
+loudly — numbers from different hardware are compared only because you
+asked, not silently. Wired as ``make bench-diff``
+(``OLD=... NEW=... make bench-diff``).
+
+No jax import: this must run anywhere, instantly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, Optional
+
+# higher is better
+THROUGHPUT_KEYS = (
+    "value",
+    "fp32_samples_per_sec",
+    "bf16_samples_per_sec",
+    "bf16_params_samples_per_sec",
+    "bf16_per_dispatch_samples_per_sec",
+    "uncapped_bf16_samples_per_sec",
+    "multihot_ragged_samples_per_sec",
+    "criteo1tb_shard_samples_per_sec",
+    "input_pipeline_samples_per_sec",
+)
+# lower is better
+MS_KEYS = (
+    "tiny_zoo_adagrad_ms_per_iter",
+    "tiny_zoo_sgd_ms_per_iter",
+    "tiny_zoo_adagrad_bf16_ms_per_iter",
+    "criteo1tb_v5e16_step_ms",
+)
+ENV_KEYS = ("backend", "device_count", "jax_version", "smoke")
+
+
+def load_bench(path: str) -> Optional[Dict[str, Any]]:
+    """Extract the bench payload from either the driver wrapper or a raw
+    bench.py JSON line (last parseable JSON object wins for line files)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        print(f"compare_bench: cannot read {path}: {e}", file=sys.stderr)
+        return None
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        # maybe a JSONL tail (e.g. a sidecar) — take the last object line
+        doc = None
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+        if doc is None:
+            print(f"compare_bench: no JSON payload in {path}",
+                  file=sys.stderr)
+            return None
+    if isinstance(doc, dict) and "parsed" in doc and "rc" in doc:
+        if doc["parsed"] is None:
+            print(f"compare_bench: {path} is a driver record whose bench "
+                  f"run failed (rc={doc.get('rc')}); nothing to compare",
+                  file=sys.stderr)
+            return None
+        doc = doc["parsed"]
+    if isinstance(doc, dict) and "section" in doc:
+        # SectionRecorder sidecar (BENCH.partial.jsonl): the bench payload
+        # of the "final" record is nested under "value"
+        if doc.get("section") == "final" and isinstance(doc.get("value"),
+                                                        dict):
+            doc = doc["value"]
+        else:
+            print(f"compare_bench: {path} is a sidecar without a completed "
+                  "'final' record (run killed mid-way?); nothing to compare",
+                  file=sys.stderr)
+            return None
+    if not isinstance(doc, dict) or "metric" not in doc:
+        print(f"compare_bench: {path} does not look like a bench record",
+              file=sys.stderr)
+        return None
+    return doc
+
+
+def check_env(old: Dict[str, Any], new: Dict[str, Any]) -> None:
+    """Print a loud warning when the PR 2 env stamps disagree."""
+    oenv, nenv = old.get("env"), new.get("env")
+    if not (isinstance(oenv, dict) and isinstance(nenv, dict)):
+        return
+    for k in ENV_KEYS:
+        if k in oenv and k in nenv and oenv[k] != nenv[k]:
+            print(f"compare_bench: WARNING env mismatch on {k!r}: "
+                  f"{oenv[k]!r} vs {nenv[k]!r} — numbers are not "
+                  "apples-to-apples", file=sys.stderr)
+
+
+def compare(old: Dict[str, Any], new: Dict[str, Any],
+            threshold: float) -> int:
+    regressions = 0
+    rows = []
+    for keys, higher_better in ((THROUGHPUT_KEYS, True), (MS_KEYS, False)):
+        for k in keys:
+            ov, nv = old.get(k), new.get(k)
+            if not isinstance(ov, (int, float)) or not isinstance(
+                    nv, (int, float)):
+                if (ov is None) != (nv is None):
+                    rows.append((k, ov, nv, None, "only-one-side"))
+                continue
+            if not ov:
+                # a failed section records 0.0 (bench _guard default):
+                # not comparable, but NEVER silently dropped — a section
+                # flipping between failed and healthy must stay visible
+                rows.append((k, ov, nv, None, "baseline-zero"))
+                continue
+            change = (nv - ov) / ov
+            regressed = (change < -threshold if higher_better
+                         else change > threshold)
+            rows.append((k, ov, nv, change,
+                         "REGRESSION" if regressed else "ok"))
+            regressions += bool(regressed)
+    width = max((len(r[0]) for r in rows), default=10)
+    for k, ov, nv, change, verdict in rows:
+        pct = "" if change is None else f"{change * 100:+7.1f}%  "
+        print(f"{k:<{width}}  {ov!s:>12} -> {nv!s:>12}  {pct}{verdict}")
+    if regressions:
+        print(f"compare_bench: {regressions} metric(s) regressed beyond "
+              f"{threshold * 100:.0f}%", file=sys.stderr)
+        return 1
+    print(f"compare_bench: OK ({len(rows)} metric(s) compared, none beyond "
+          f"{threshold * 100:.0f}%)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("old", help="baseline BENCH json (driver wrapper or "
+                                "raw bench.py line)")
+    ap.add_argument("new", help="candidate BENCH json")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="max tolerated fractional regression "
+                         "(default 0.10 = 10%%)")
+    args = ap.parse_args(argv)
+    old, new = load_bench(args.old), load_bench(args.new)
+    if old is None or new is None:
+        return 2
+    check_env(old, new)
+    return compare(old, new, args.threshold)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,40 +5,17 @@ phase split): how much is the unavoidable SGD scatter, how much is glue
 Usage: python tools/profile_apply.py
 """
 
-import time
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-CAP_SIZES = [min(s, 2_000_000) for s in [
-    1460, 583, 10131227, 2202608, 305, 24, 12517, 633, 3, 93145, 5683,
-    8351593, 3194, 27, 14992, 5461306, 10, 5652, 2173, 4, 7046547, 18, 15,
-    286181, 105, 142572]]
+import _profcommon as pc
+from _profcommon import slope_donate
+
+CAP_SIZES = pc.CAP_SIZES
 B = 65536
 N = 26
 W = 128
-
-
-def readback(x):
-    return float(jnp.asarray(x).reshape(-1)[0])
-
-
-def slope_donate(make_fn, args, iters_hi=3):
-    f1 = jax.jit(make_fn(1), donate_argnums=(0,))
-    fh = jax.jit(make_fn(iters_hi), donate_argnums=(0,))
-
-    state = {"args": args}
-
-    def run(f):
-        s, sl = f(*state["args"])
-        state["args"] = (sl,) + state["args"][1:]
-        return readback(s)
-
-    run(f1); run(fh)
-    t0 = time.perf_counter(); run(f1); t1 = time.perf_counter()
-    run(fh); t2 = time.perf_counter()
-    return ((t2 - t1) - (t1 - t0)) / (iters_hi - 1) * 1e3
 
 
 def main():
@@ -104,4 +81,5 @@ def main():
 
 
 if __name__ == "__main__":
+    pc.ensure_backend()  # probe-first: a stalled tunnel must not hang us
     main()

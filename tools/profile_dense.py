@@ -13,7 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-sys.path.insert(0, ".")
+import _profcommon as pc  # repo on sys.path + probe-first backend gate
 import distributed_embeddings_tpu.models.dlrm as dlrm_mod
 from bench import BATCH, make_cfg, timed_loop
 
@@ -58,6 +58,7 @@ def run(batch):
 
 
 if __name__ == "__main__":
+    pc.ensure_backend()  # probe-first: a stalled tunnel must not hang us
     which = sys.argv[1] if len(sys.argv) > 1 else "current"
     if which == "matmul":
         dlrm_mod.dot_interact = dot_interact_mm

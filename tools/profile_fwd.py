@@ -15,33 +15,18 @@ Usage: python tools/profile_fwd.py [stage ...]
 """
 
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-CAP_SIZES = [min(s, 2_000_000) for s in [
-    1460, 583, 10131227, 2202608, 305, 24, 12517, 633, 3, 93145, 5683,
-    8351593, 3194, 27, 14992, 5461306, 10, 5652, 2173, 4, 7046547, 18, 15,
-    286181, 105, 142572]]
+import _profcommon as pc
+from _profcommon import readback, slope
+
+CAP_SIZES = pc.CAP_SIZES
 B = 16384
 N = 26
 W = 128
-
-
-def readback(x):
-    return float(jnp.asarray(x).reshape(-1)[0])
-
-
-def slope(make_fn, args, iters_hi=3):
-    f1 = jax.jit(make_fn(1))
-    fh = jax.jit(make_fn(iters_hi))
-    readback(f1(*args))
-    readback(fh(*args))
-    t0 = time.perf_counter(); readback(f1(*args)); t1 = time.perf_counter()
-    readback(fh(*args)); t2 = time.perf_counter()
-    return ((t2 - t1) - (t1 - t0)) / (iters_hi - 1) * 1e3
 
 
 def main(stages):
@@ -241,4 +226,5 @@ def main(stages):
 
 
 if __name__ == "__main__":
+    pc.ensure_backend()  # probe-first: a stalled tunnel must not hang us
     main(sys.argv[1:])

@@ -11,36 +11,19 @@ Usage: python tools/profile_ragged.py [phase ...]
 """
 
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-CAP_SIZES = [min(s, 2_000_000) for s in [
-    1460, 583, 10131227, 2202608, 305, 24, 12517, 633, 3, 93145, 5683,
-    8351593, 3194, 27, 14992, 5461306, 10, 5652, 2173, 4, 7046547, 18, 15,
-    286181, 105, 142572]]
+import _profcommon as pc
+from _profcommon import readback, slope, slope_donate
+
+CAP_SIZES = pc.CAP_SIZES
 B = 16384
 N = 26
 HOT_MEAN = 15
 W = 128
-
-
-def readback(x):
-    return float(jnp.asarray(x).reshape(-1)[0])
-
-
-def slope(make_fn, args, iters_hi=3):
-    """Time K=1 vs K=hi in-jit repetitions, report the slope in ms."""
-    f1 = jax.jit(make_fn(1))
-    fh = jax.jit(make_fn(iters_hi))
-    readback(f1(*args))  # compile
-    readback(fh(*args))
-    t0 = time.perf_counter(); readback(f1(*args)); t1 = time.perf_counter()
-    readback(fh(*args)); t2 = time.perf_counter()
-    d1, dh = t1 - t0, t2 - t1
-    return (dh - d1) / (iters_hi - 1) * 1e3
 
 
 def main(phases):
@@ -172,23 +155,6 @@ def main(phases):
         print(f"bwd grad take bf16: {slope(mk, (grad, sidx)):.1f} ms",
               flush=True)
 
-    def slope_donate(make_fn, args, iters_hi=3):
-        """Like slope() but donates the first arg (the slab) — without
-        donation XLA copies the 5 GB slab and the program OOMs."""
-        f1 = jax.jit(make_fn(1), donate_argnums=(0,))
-        fh = jax.jit(make_fn(iters_hi), donate_argnums=(0,))
-
-        def run(f):
-            nonlocal args
-            s, sl = f(*args)
-            args = (sl,) + args[1:]
-            return readback(s)
-
-        run(f1); run(fh)
-        t0 = time.perf_counter(); run(f1); t1 = time.perf_counter()
-        run(fh); t2 = time.perf_counter()
-        return ((t2 - t1) - (t1 - t0)) / (iters_hi - 1) * 1e3
-
     if want("opt_scatter"):
         upd = jnp.zeros((N * cap, W), jnp.float32) + 1e-4
 
@@ -220,4 +186,5 @@ def main(phases):
 
 
 if __name__ == "__main__":
+    pc.ensure_backend()  # probe-first: a stalled tunnel must not hang us
     main(sys.argv[1:])

@@ -8,16 +8,15 @@ Usage: python tools/profile_step.py [ragged|dense] [batch]
 """
 
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
 
-sys.path.insert(0, ".")
-from bench import (BATCH, CRITEO_KAGGLE_SIZES, CAP, build_state, make_cfg,
-                   timed_loop)
+import _profcommon as pc  # repo on sys.path + probe-first backend gate
+from bench import BATCH, build_state, make_cfg, timed_loop
+from _profcommon import CAP, CRITEO_KAGGLE_SIZES
 from distributed_embeddings_tpu.models.dlrm import DLRMDense, bce_with_logits
 from distributed_embeddings_tpu.ops.embedding_lookup import Ragged
 from distributed_embeddings_tpu.parallel import (
@@ -133,7 +132,8 @@ def main():
 
     # --- 3: full step -----------------------------------------------------
     step_fn = make_hybrid_train_step(de, loss_fn, tx, emb_opt,
-                                     lr_schedule=0.005)
+                                     lr_schedule=0.005,
+                                     with_metrics=False)
     dt3 = timed_loop(step_fn, state, (cats, (num, labels)), iters=8)
     print(f"full step: {dt3*1e3:.1f} ms -> {batch/dt3:.0f} samples/s",
           flush=True)
@@ -143,4 +143,5 @@ def main():
 
 
 if __name__ == "__main__":
+    pc.ensure_backend()  # probe-first: a stalled tunnel must not hang us
     main()
