@@ -4,7 +4,7 @@
 SHELL := /bin/bash
 
 .PHONY: all native test test-fast bench bench-diff clean pkg verify \
-        check-backend check-obs
+        check-backend check-obs check-resilience
 
 all: native
 
@@ -23,9 +23,9 @@ bench:
 	python bench.py
 
 # the driver's tier-1 gate (ROADMAP.md "Tier-1 verify", verbatim semantics)
-# plus the static no-eager-backend check and the observability gate — run
-# before shipping a round
-verify: check-backend check-obs
+# plus the static no-eager-backend check, the observability gate, and the
+# preemption-recovery drill — run before shipping a round
+verify: check-backend check-obs check-resilience
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -43,6 +43,11 @@ check-backend:
 # the DETPU_OBS=1 smoke bench emits a parseable step-metrics sidecar
 check-obs:
 	python tools/check_obs.py
+
+# preemption drill: SIGTERM a child resilient driver mid-run, resume it,
+# and require the final state to match an uninterrupted run bit for bit
+check-resilience:
+	python tools/check_resilience.py
 
 # optional regression gate: diff two BENCH records, nonzero exit on a >10%
 # throughput regression. Usage: make bench-diff OLD=BENCH_r04.json NEW=out.json

@@ -28,6 +28,12 @@ from .sparse_optax import (
     sparse_value_and_grad,
     unique_ids_static,
 )
+from .resilient import (
+    PREEMPT_EXIT_CODE,
+    ResilientResult,
+    resume_sentinel_path,
+    run_resilient,
+)
 from .trainer import (
     HybridTrainState,
     init_hybrid_state,

@@ -64,6 +64,7 @@ from jax import lax
 
 from .. import compat
 from ..utils import obs
+from ..utils import runtime as _runtime
 from ..layers.embedding import default_embeddings_init
 from ..ops.embedding_lookup import (Ragged, SparseIds, ragged_row_ids,
                                     row_to_split)
@@ -162,6 +163,33 @@ class DistributedEmbedding:
         gathered row; makes bad-pipeline ids visible as zeros instead of
         silently training on the clipped row's values. Row-sliced tables
         use masked reads regardless (their correctness depends on it).
+      invalid_id_policy: what negative / out-of-vocab ids do — the single
+        ingestion-point policy for every input path (dense, ragged,
+        sparse, mp-packed):
+
+        * ``'clamp'`` (default, the historical behavior): the forward
+          READ clamps into the table (negatives read row 0, overflow
+          reads the last row) and the backward drops the id — a bad id
+          reads a defined row but trains nothing.
+        * ``'drop'``: invalid ids contribute a ZERO row forward and drop
+          backward (forces ``masked_reads``) — a bad id neither reads
+          nor trains anything.
+        * ``'raise'``: eager (host-visible) ingestion —
+          :meth:`check_inputs`, called automatically on concrete inputs
+          and by the resilient driver before each dispatch — raises
+          :class:`~...utils.runtime.InvalidInputError` naming the input
+          and the offending count. Inside an already-jitted step the ids
+          are tracers; there the read behaves like ``'clamp'`` and the
+          violation surfaces through the ``invalid_id_count`` step
+          metric (which ``parallel.resilient.run_resilient`` escalates).
+
+        All three policies surface the per-rank count of invalid live ids
+        as ``invalid_id_count`` in :meth:`step_metrics`.
+      ragged_overflow_raise: opt-in escalation for ragged batches whose
+        claimed row lengths overflow their static capacity (ids silently
+        truncated otherwise): :meth:`check_inputs` raises
+        :class:`~...utils.runtime.InvalidInputError`, and the resilient
+        driver escalates on a nonzero ``id_overflow`` metric.
       dp_input: if True (default) inputs are data-parallel shards
         ``[local_batch, ...]`` per global feature. If False, inputs are
         model-parallel: a :class:`MpInputs` built by :meth:`pack_mp_inputs`
@@ -191,7 +219,9 @@ class DistributedEmbedding:
                  axis_name: str = "data",
                  compute_dtype: Optional[Any] = None,
                  input_hotness: Optional[Sequence[int]] = None,
-                 masked_reads: bool = False):
+                 masked_reads: bool = False,
+                 invalid_id_policy: str = "clamp",
+                 ragged_overflow_raise: bool = False):
         if row_slice is not None and (isinstance(row_slice, bool)
                                       or not isinstance(row_slice, int)):
             # bool subclasses int: row_slice=True would silently mean
@@ -199,11 +229,19 @@ class DistributedEmbedding:
             raise TypeError(
                 "row_slice takes an int element threshold (the reference "
                 "left the type 'TBD'; see the class docstring)")
+        if invalid_id_policy not in ("clamp", "drop", "raise"):
+            raise ValueError(
+                f"invalid_id_policy must be 'clamp' | 'drop' | 'raise', "
+                f"got {invalid_id_policy!r}")
         self.world_size = int(world_size)
         self.axis_name = axis_name
         self.dp_input = dp_input
         self.compute_dtype = compute_dtype
-        self.masked_reads = bool(masked_reads)
+        self.invalid_id_policy = invalid_id_policy
+        self.ragged_overflow_raise = bool(ragged_overflow_raise)
+        # 'drop' rides the masked-read machinery: zero forward read,
+        # dropped backward — exactly the drop semantics, per slot
+        self.masked_reads = bool(masked_reads) or invalid_id_policy == "drop"
         self.strategy = DistEmbeddingStrategy(
             embeddings, self.world_size, strategy=strategy,
             input_table_map=input_table_map,
@@ -460,6 +498,87 @@ class DistributedEmbedding:
         w = jnp.asarray(weights).astype(jnp.float32).reshape(cap)
         return lax.bitcast_convert_type(w, jnp.int32).astype(comm_dtype)
 
+    def check_inputs(self, inputs) -> Optional[int]:
+        """Eager (host-side) ingestion validation — the enforcement point
+        of ``invalid_id_policy='raise'`` and ``ragged_overflow_raise``.
+
+        Counts negative / out-of-vocab ids per input against the GLOBAL
+        table vocab, and ragged row lengths claiming more ids than their
+        static capacity. Under the ``'raise'`` policy any invalid id
+        raises :class:`~...utils.runtime.InvalidInputError` naming the
+        input and the offending range; with ``ragged_overflow_raise`` any
+        capacity overflow does too. ``None`` entries (multi-host
+        ``pack_mp_inputs`` partial batches) are skipped.
+
+        Returns the total invalid-id count, or ``None`` when any input is
+        a tracer — inside a jitted step nothing can be read eagerly; there
+        the in-step ``invalid_id_count`` / ``id_overflow`` metrics carry
+        the signal and the resilient driver escalates on the host.
+
+        Cost: one device→host fetch per input when ids live on device —
+        the price the ``'raise'`` policy opts into (call it from the input
+        pipeline, where ids are still host numpy, to pay nothing).
+        """
+        import jax.core as _jcore
+
+        if isinstance(inputs, MpInputs):
+            # already validated id-by-id inside pack_mp_inputs (host
+            # numpy); the packed block cannot be re-attributed to inputs
+            return None
+        if len(inputs) != self.strategy.num_inputs:
+            raise ValueError(
+                f"Expected {self.strategy.num_inputs} inputs, "
+                f"got {len(inputs)}")
+        total = 0
+        for i, inp in enumerate(inputs):
+            if inp is None:
+                continue
+            tid = self.strategy.input_table_map[i]
+            vocab = int(self.strategy.global_configs[tid]["input_dim"])
+            if isinstance(inp, SparseIds):
+                arrs = (inp.values, inp.indices)
+                values, splits, cap = inp.values, None, None
+            elif isinstance(inp, Ragged):
+                arrs = (inp.values, inp.row_splits)
+                values, splits = inp.values, inp.row_splits
+                cap = int(np.shape(inp.values)[0])
+            else:
+                arrs = (inp,)
+                values, splits, cap = inp, None, None
+            if any(isinstance(a, _jcore.Tracer) for a in arrs):
+                return None
+            ids = np.asarray(values)
+            if isinstance(inp, SparseIds):
+                # padding positions are marked by row >= dense_shape[0]
+                # and carry ARBITRARY values (the SparseIds contract) —
+                # only live positions are checkable
+                rows_coo = np.asarray(inp.indices)
+                if rows_coo.ndim == 2:
+                    rows_coo = rows_coo[:, 0]
+                ids = ids[rows_coo < inp.dense_shape[0]]
+            if splits is not None:
+                sp = np.asarray(splits)
+                nnz = int(sp.reshape(-1)[-1])
+                if nnz > cap:
+                    total += nnz - cap
+                    if self.ragged_overflow_raise:
+                        raise _runtime.InvalidInputError(
+                            f"input {i}: ragged row lengths claim {nnz} "
+                            f"ids > static capacity {cap} — "
+                            f"{nnz - cap} id(s) would be silently "
+                            "truncated (ragged_overflow_raise)")
+                ids = ids.reshape(-1)[:min(nnz, cap)]
+            bad = int(((ids < 0) | (ids >= vocab)).sum())
+            if bad:
+                total += bad
+                if self.invalid_id_policy == "raise":
+                    raise _runtime.InvalidInputError(
+                        f"input {i} (table {tid}): {bad} id(s) outside "
+                        f"[0, {vocab}) — min {int(ids.min())}, max "
+                        f"{int(ids.max())} — under invalid_id_policy="
+                        "'raise'")
+        return total
+
     def _normalize_inputs(self, inputs):
         """Promote to a common int dtype; dense inputs flatten to 2-D
         ``[batch, -1]``, :class:`~..ops.embedding_lookup.Ragged` inputs
@@ -473,6 +592,12 @@ class DistributedEmbedding:
         if len(inputs) != self.strategy.num_inputs:
             raise ValueError(
                 f"Expected {self.strategy.num_inputs} inputs, got {len(inputs)}")
+        if self.invalid_id_policy == "raise" or self.ragged_overflow_raise:
+            # the single ingestion point: eager callers (and the mp pack)
+            # get host-side raises; traced callers fall through to the
+            # invalid_id_count / id_overflow metrics (check_inputs
+            # returns None on tracers)
+            self.check_inputs(inputs)
         # COO sparse rides the ragged path: row ids -> CSR row_splits, the
         # same conversion the op layer's dispatcher does
         # (ops/embedding_lookup.py:row_to_split; reference
@@ -589,6 +714,10 @@ class DistributedEmbedding:
         if len(arrs) != self.strategy.num_inputs:
             raise ValueError(
                 f"Expected {self.strategy.num_inputs} inputs, got {len(arrs)}")
+        if self.invalid_id_policy == "raise" or self.ragged_overflow_raise:
+            # mp ingestion point: ids are host numpy here, so the 'raise'
+            # policy costs nothing extra (None entries skipped)
+            self.check_inputs(arrs)
 
         def glen(a):
             return (a.row_splits.shape[0] - 1 if isinstance(a, Ragged)
@@ -1148,12 +1277,19 @@ class DistributedEmbedding:
 
     def _apply_width_streams(self, params: EmbedParams, opt_state,
                              per_width: Dict[str, List], optimizer, lr,
-                             scale):
+                             scale, enable=None):
         """Concatenate each width's (logical ids, update rows) stream,
         lane-expand to physical full-tile rows, and run ONE optimizer scatter
         per width slab. Stateful-moment optimizers additionally receive the
         lane touch-mask (``ops/packed_slab.py:expand_touch_mask``) so packed
-        neighbour rows keep their state."""
+        neighbour rows keep their state.
+
+        ``enable`` (scalar bool, traced): when False every update row is
+        routed to the dropped sentinel — the scatters drop out of bounds,
+        so the slabs AND every slab-shaped optimizer state component stay
+        bitwise-unchanged. This is the non-finite guard's skip path: an
+        O(ids) mask instead of a slab-wide select (which would read+write
+        gigabytes of tables per step just to discard the result)."""
         new_params = dict(params)
         new_state = dict(opt_state) if isinstance(opt_state, dict) else opt_state
         wants_mask = getattr(optimizer, "needs_touch_mask", False)
@@ -1162,6 +1298,11 @@ class DistributedEmbedding:
                 tris = per_width[k]
                 w = tris[0][2]
                 ids = jnp.concatenate([t[0].reshape(-1) for t in tris])
+                if enable is not None:
+                    # disabled step: all rows -> logical sentinel (the same
+                    # dropped-row id the backward uses for OOB ids)
+                    ids = jnp.where(enable, ids,
+                                    jnp.asarray(self.rows_cap[w], ids.dtype))
                 vals = jnp.concatenate(
                     [t[1].reshape(-1, w) for t in tris]) * scale
                 # lane-expand to physical rows: the scatter (and any dedup
@@ -1189,7 +1330,8 @@ class DistributedEmbedding:
         return new_params, new_state
 
     def sparse_apply_gradients(self, params: EmbedParams, opt_state, residuals,
-                               out_grads, optimizer, lr, scale=None):
+                               out_grads, optimizer, lr, scale=None,
+                               enable=None):
         """Manual sparse backward + in-place optimizer update.
 
         Replaces autodiff w.r.t. the parameter slabs: ``out_grads`` are the
@@ -1211,6 +1353,11 @@ class DistributedEmbedding:
           scale: gradient pre-scale; defaults to ``1/world_size``, matching the
             reference's mp-gradient scaling (``dist_model_parallel.py:542-546``)
             under a pmean-averaged data-parallel loss.
+          enable: optional traced scalar bool — when False the whole update
+            is skipped with slabs and slab-shaped optimizer state bitwise
+            unchanged (every update row routes to the dropped sentinel; see
+            :meth:`_apply_width_streams`). The trainer's non-finite guard
+            passes its finiteness verdict here.
 
         Returns:
           ``(new_params, new_opt_state)``.
@@ -1367,7 +1514,7 @@ class DistributedEmbedding:
                 (ids, vals, g.width))
 
         return self._apply_width_streams(params, opt_state, per_width,
-                                         optimizer, lr, scale)
+                                         optimizer, lr, scale, enable=enable)
 
     # --------------------------------------------------------- observability
 
@@ -1390,6 +1537,10 @@ class DistributedEmbedding:
           the slot's static capacity: every unit here is an id the lookup
           silently dropped (the "ragged ids silently overflow ``CAP``"
           failure made visible). Zero on healthy batches.
+        * ``invalid_id_count`` — negative / out-of-vocab ids among the
+          live ids this rank received (what the ``invalid_id_policy``
+          clamped or dropped; row-sliced slots excluded — each id is
+          in-range on exactly one slice). Zero on healthy batches.
         * ``id_a2a_bytes`` / ``out_a2a_bytes`` / ``grad_a2a_bytes`` —
           bytes leaving this chip per step for the dp→mp id exchange, the
           mp→dp activation exchange, and the reverse cotangent exchange
@@ -1425,22 +1576,45 @@ class DistributedEmbedding:
                 dense_live[inst.rank, 0] += world * b * inst.num_slots * g.hot
         routed = self._plan_row(dense_live, my).astype(jnp.int32)
         overflow = routed * 0  # zero that inherits routed's varying type
+        invalid = routed * 0
         for gi, g in enumerate(plan.groups):
-            if g.kind == "d":
-                continue
             region = lax.slice(ids_recv, (0, g.goff),
                                (world, g.goff + g.n * g.blen))
-            lengths = region.reshape(world, g.n, g.blen)[:, :, g.hot:g.hot + b]
+            rows = self._plan_row(plan.rows[gi], my)  # [n] per-slot vocab
+            # invalid-id counting skips dead slots (their zero-filled ids
+            # would compare against rows=0) and row-sliced slots (a valid
+            # id is in-range on exactly ONE of its k slices — per-slot
+            # counting would tally k-1 phantom invalids per id)
+            slot_ok = ((self._plan_row(plan.valid[gi], my) > 0)
+                       & (self._plan_row(plan.rsliced[gi], my) == 0))
+            if g.kind == "d":
+                ids = region.reshape(world, g.n, b, g.hot)
+                bad = (((ids < 0) | (ids >= rows[None, :, None, None]))
+                       & slot_ok[None, :, None, None])
+                invalid = invalid + jnp.sum(bad, dtype=jnp.int32).reshape(1)
+                continue
+            r3 = region.reshape(world, g.n, g.blen)
+            values = r3[:, :, :g.hot]
+            lengths = r3[:, :, g.hot:g.hot + b]
             tot = jnp.sum(lengths, axis=2, dtype=jnp.int32)  # [world, n]
             # dead slots carry zero lengths by construction (senders fill
             # dead cells with zeros), so no valid-mask is needed here
             routed = routed + jnp.sum(jnp.minimum(tot, g.hot)).reshape(1)
             overflow = overflow + jnp.sum(
                 jnp.maximum(tot - g.hot, 0)).reshape(1)
+            # live ragged positions are packed from position 0 (senders
+            # zero-fill past nnz), so a position index < clamped total
+            # marks a real id
+            live = (jnp.arange(g.hot, dtype=jnp.int32)[None, None, :]
+                    < jnp.minimum(tot, g.hot)[:, :, None])
+            bad = (((values < 0) | (values >= rows[None, :, None]))
+                   & live & slot_ok[None, :, None])
+            invalid = invalid + jnp.sum(bad, dtype=jnp.int32).reshape(1)
         off_chip = float(world - 1)
         return {
             "ids_routed": routed,
             "id_overflow": overflow,
+            "invalid_id_count": invalid,
             "id_a2a_bytes": self._vary(jnp.full(
                 (1,), off_chip * plan.l_max * id_bytes, jnp.float32)),
             "out_a2a_bytes": self._vary(jnp.full(

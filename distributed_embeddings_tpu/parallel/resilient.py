@@ -1,0 +1,410 @@
+"""Self-healing training driver: preemption-safe resume, non-finite-loss
+escalation, and invalid-input enforcement around the hybrid train step.
+
+PR 1 made the *artifacts* crash-safe (atomic CRC-manifested checkpoints,
+``.prev`` fallback) and PR 2 made the step *observable* (``step_metrics``,
+counters) — but the training loop itself still died on SIGTERM with all
+work since the last manual save lost, and a poisoned batch either corrupted
+the sharded tables (guard off) or spun forever (guard on, nobody watching).
+:func:`run_resilient` closes that loop around any step built by
+:func:`~.trainer.make_hybrid_train_step`:
+
+* **Periodic + wall-clock-budget checkpointing** through the atomic
+  :func:`~..utils.checkpoint.save_train_state` (tmp+fsync+rename staging
+  swap; a kill at any point leaves a whole checkpoint on disk).
+* **Preemption handling**: SIGTERM/SIGINT set a flag, the in-flight step
+  finishes, the state checkpoints, a resume sentinel
+  (``<checkpoint_dir>.resume.json``) is written, and the driver returns
+  ``preempted=True`` (or exits with :data:`PREEMPT_EXIT_CODE` under
+  ``exit_on_preempt=True`` — the contract orchestrators requeue on).
+* **Auto-resume**: the latest valid checkpoint is restored
+  (CRC-verified, ``.prev`` fallback, :class:`~..utils.runtime.
+  CheckpointMismatch` on config drift) and the data source is
+  deterministically fast-forwarded (:func:`~..utils.data.fast_forward`)
+  so no batch is replayed or skipped — an interrupted+resumed run
+  reproduces the uninterrupted trajectory bit for bit.
+* **Non-finite escalation**: the on-device guard
+  (:func:`~.trainer.make_hybrid_train_step` with ``nan_guard``, default
+  ``DETPU_NANGUARD`` = on) skips poisoned updates with params bitwise
+  unchanged; this driver counts consecutive skips on the host (the step's
+  returned loss stays truthfully non-finite) and raises
+  :class:`~..utils.runtime.NonFiniteLossError` naming the last good step
+  after K (``DETPU_NANGUARD_K``, default 3) — after a final checkpoint of
+  the still-clean state.
+* **Invalid-input enforcement**: under
+  ``DistributedEmbedding(invalid_id_policy='raise')`` each batch is
+  host-validated before dispatch (:meth:`~.dist_embedding.
+  DistributedEmbedding.check_inputs`); with ``ragged_overflow_raise`` a
+  nonzero on-device ``id_overflow`` metric escalates too.
+* **Fault-injection hooks**: every recovery path is exercisable on CPU —
+  ``DETPU_FAULT=preempt@<step>`` delivers a real self-SIGTERM at that step
+  boundary, and ``die:driver.step`` / ``die:driver.save`` /
+  ``die:driver.resume`` / ``die:driver.final`` (plus the checkpoint
+  layer's own points) kill the process inside each driver phase.
+
+The reference library (mikemckiernan/distributed-embeddings) leaves all of
+this to the user — its examples train in a bare loop and checkpoint only
+embedding weights at the end (``examples/dlrm/main.py:246-248`` there).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import math
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ..utils import obs, runtime
+from ..utils.checkpoint import restore_train_state, save_train_state
+from ..utils.data import fast_forward
+
+logger = logging.getLogger(__name__)
+
+#: Process exit code of a preempted-and-checkpointed run under
+#: ``exit_on_preempt=True`` — distinct from error codes so orchestrators
+#: (and ``tools/check_resilience.py``) can requeue instead of failing.
+PREEMPT_EXIT_CODE = 83
+
+
+def resume_sentinel_path(checkpoint_dir: str) -> str:
+    """Where the preemption exit parks its resume marker. BESIDE the
+    checkpoint directory, not inside it — the atomic save swaps the
+    directory wholesale on every checkpoint."""
+    return checkpoint_dir.rstrip(os.sep) + ".resume.json"
+
+
+@dataclasses.dataclass
+class ResilientResult:
+    """Outcome of one :func:`run_resilient` invocation."""
+
+    state: Any                 #: final HybridTrainState
+    step: int                  #: final step counter (== completed steps)
+    steps_run: int             #: steps executed by THIS invocation
+    preempted: bool            #: True when a SIGTERM/SIGINT ended the run
+    skipped_steps: int         #: host-observed non-finite (guard-skipped)
+    checkpoints_saved: int     #: checkpoints written by this invocation
+    last_loss: Optional[float]  #: last step's loss (may be non-finite)
+    stop_reason: str           #: exhausted | preempted | on_step | until_step
+    elapsed_s: float           #: wall-clock of the training loop
+
+
+class _PreemptCatcher:
+    """SIGTERM/SIGINT -> flag; the loop finishes the in-flight step and
+    checkpoints before exiting. Installed only on the main thread (signal
+    handlers cannot be set elsewhere); previous handlers are restored on
+    exit so nested drivers and test harnesses compose."""
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self):
+        self.fired: Optional[int] = None
+        self._old: Dict[int, Any] = {}
+
+    def _handler(self, signum, frame):
+        del frame
+        if self.fired is None:
+            logger.warning(
+                "run_resilient: received signal %d — finishing the "
+                "in-flight step, checkpointing, then exiting", signum)
+        self.fired = signum
+
+    def __enter__(self):
+        if threading.current_thread() is threading.main_thread():
+            for s in self.SIGNALS:
+                self._old[s] = signal.signal(s, self._handler)
+        return self
+
+    def __exit__(self, *exc):
+        for s, h in self._old.items():
+            signal.signal(s, h)
+        return False
+
+
+def _as_float(x) -> float:
+    """Host scalar of a (possibly device) loss; NaN on fetch failure."""
+    try:
+        return float(np.asarray(x).reshape(-1)[-1])
+    except Exception:  # noqa: BLE001 - a dead value must not mask the run
+        logger.exception("run_resilient: loss readback failed")
+        return float("nan")
+
+
+def run_resilient(step_fn: Callable, state, data, *,
+                  de,
+                  checkpoint_dir: Optional[str] = None,
+                  checkpoint_every_steps: int = 0,
+                  checkpoint_every_s: float = 0.0,
+                  until_step: Optional[int] = None,
+                  resume: bool = True,
+                  emb_optimizer=None,
+                  dense_tx=None,
+                  mesh=None,
+                  escalate_after: Optional[int] = None,
+                  metrics_logger=None,
+                  metrics_interval: int = 100,
+                  on_step: Optional[Callable] = None,
+                  exit_on_preempt: bool = False,
+                  save_on_exit: bool = True,
+                  is_chief: Optional[bool] = None) -> ResilientResult:
+    """Drive ``step_fn`` over ``data`` with checkpointing, preemption
+    handling, auto-resume, and poisoned-batch escalation.
+
+    Args:
+      step_fn: a step built by :func:`~.trainer.make_hybrid_train_step` —
+        ``step(state, cat_inputs, batch) -> (loss, state[, metrics])``.
+        Build it with the non-finite guard on (the default) for the
+        skip-don't-corrupt behavior this driver escalates on.
+      state: freshly initialized :class:`~.trainer.HybridTrainState`; on
+        auto-resume its ``dense_params`` serve as the restore template and
+        the restored state replaces it.
+      data: the batch source, yielding ``(cat_inputs, batch)`` pairs —
+        either a callable ``data(start_step) -> iterable`` (preferred: it
+        positions itself, e.g. ``RawBinaryDataset(start_batch=...)`` or a
+        step-seeded generator) or a plain iterable (fast-forwarded by
+        generate-and-discard). See :func:`~..utils.data.fast_forward`.
+      de: the :class:`~.dist_embedding.DistributedEmbedding` (checkpoint
+        streaming + input policies).
+      checkpoint_dir: atomic train-state checkpoint directory; ``None``
+        disables checkpointing, resume, and the preemption save (the
+        preempt flag then just stops the loop).
+      checkpoint_every_steps: save every N *absolute* steps (cadence stays
+        aligned across resumes); 0 disables the step cadence.
+      checkpoint_every_s: save when this much wall-clock passed since the
+        last save (preemption-prone fleets bound their lost work this
+        way); 0 disables the time cadence.
+      until_step: stop once ``state.step`` reaches this absolute step
+        (resume-friendly alternative to sizing the iterator).
+      resume: restore from ``checkpoint_dir`` when a valid checkpoint (or
+        its ``.prev`` fallback) exists; requires ``emb_optimizer`` and
+        ``dense_tx`` (the :func:`~..utils.checkpoint.restore_train_state`
+        arguments).
+      escalate_after: consecutive non-finite-loss steps before
+        :class:`~..utils.runtime.NonFiniteLossError`; default
+        ``DETPU_NANGUARD_K`` (3). The state is checkpointed first — under
+        the guard it still holds the last good values.
+      metrics_logger: chief-side :class:`~..utils.obs.MetricsLogger`; when
+        the step returns metrics, every process joins the collective
+        :func:`~..utils.obs.fetch_metrics` each ``metrics_interval`` steps
+        and the chief logs the record.
+      on_step: ``on_step(step, loss, metrics, state) -> stop`` host
+        callback after each step (eval cadence, printing, early stop) —
+        truthy return stops the loop cleanly.
+      exit_on_preempt: after the preemption checkpoint+sentinel, call
+        ``sys.exit(PREEMPT_EXIT_CODE)`` instead of returning. Ignored
+        without ``checkpoint_dir`` — exit code 83 asserts a checkpoint
+        exists to requeue on; an uncheckpointed preemption returns a
+        normal ``preempted=True`` result instead.
+      save_on_exit: checkpoint once more on clean completion (and clear
+        the resume sentinel).
+      is_chief: multi-host chief override (default: process 0 writes).
+
+    Returns:
+      :class:`ResilientResult`. Never returns on preemption when
+      ``exit_on_preempt=True``.
+    """
+    if checkpoint_dir is None and resume:
+        resume = False
+    if escalate_after is None:
+        escalate_after = obs.nanguard_escalation_k()
+
+    if is_chief is None:
+        def _chief() -> bool:
+            import jax
+            return jax.process_index() == 0
+    else:
+        def _chief() -> bool:
+            return bool(is_chief)
+
+    # ---- auto-resume -----------------------------------------------------
+    ckpt_meta = os.path.join(checkpoint_dir, "meta.json") \
+        if checkpoint_dir else None
+    have_ckpt = checkpoint_dir is not None and (
+        os.path.isfile(ckpt_meta)
+        or os.path.isdir(checkpoint_dir + ".prev"))
+    if resume and have_ckpt:
+        if emb_optimizer is None or dense_tx is None:
+            raise ValueError(
+                "run_resilient(resume=True) with an existing checkpoint "
+                "needs emb_optimizer= and dense_tx= to rebuild the state")
+        runtime.fault_point("driver.resume")
+        state = restore_train_state(
+            checkpoint_dir, de, emb_optimizer, state.dense_params,
+            dense_tx, mesh=mesh)
+        logger.info("run_resilient: resumed at step %d from %s",
+                    int(state.step), checkpoint_dir)
+
+    start_step = int(state.step)
+    batches = fast_forward(data, start_step)
+
+    saves = 0
+    last_save_t = time.monotonic()
+
+    def _save():
+        nonlocal saves, last_save_t
+        runtime.fault_point("driver.save")
+        save_train_state(checkpoint_dir, de, state, is_chief=is_chief)
+        saves += 1
+        last_save_t = time.monotonic()
+
+    def _sentinel(write: bool, **fields):
+        if checkpoint_dir is None or not _chief():
+            return
+        path = resume_sentinel_path(checkpoint_dir)
+        if not write:
+            if os.path.exists(path):
+                os.remove(path)
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(dict(fields, time=time.time()), f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    step = start_step - 1
+    steps_run = 0
+    skipped = 0
+    consecutive = 0
+    last_good = start_step - 1
+    last_loss: Optional[float] = None
+    preempted = False
+    stop_reason = "exhausted"
+    check_ids = (de is not None
+                 and (de.invalid_id_policy == "raise"
+                      or de.ragged_overflow_raise))
+    t0 = time.monotonic()
+
+    with _PreemptCatcher() as catcher:
+        for step, item in enumerate(batches, start=start_step):
+            if until_step is not None and step >= until_step:
+                stop_reason = "until_step"
+                break
+            runtime.fault_point("driver.step")
+            if runtime.preempt_step() == step:
+                # the preemption drill: a REAL self-SIGTERM at this step
+                # boundary, caught by the handler like any external one
+                os.kill(os.getpid(), signal.SIGTERM)
+            try:
+                cat_inputs, batch = item
+            except (TypeError, ValueError) as e:
+                raise ValueError(
+                    "run_resilient data must yield (cat_inputs, batch) "
+                    f"pairs; got {type(item).__name__}") from e
+            if check_ids:
+                de.check_inputs(cat_inputs)
+
+            out = step_fn(state, cat_inputs, batch)
+            loss, state = out[0], out[1]
+            metrics = out[2] if len(out) > 2 else None
+            steps_run += 1
+
+            # ---- host view of the on-device guard ------------------------
+            last_loss = _as_float(loss)
+            skipped_now = not math.isfinite(last_loss)
+            if not skipped_now and metrics is not None \
+                    and "skipped_steps" in metrics:
+                # the guard can also skip on non-finite GRADIENT energy
+                # with a finite loss — the on-device flag is the
+                # authoritative verdict when the step is instrumented
+                skipped_now = float(
+                    np.asarray(metrics["skipped_steps"]).max()) > 0
+            if not skipped_now:
+                consecutive = 0
+                last_good = step
+            else:
+                consecutive += 1
+                skipped += 1
+                obs.counter_inc("nonfinite_steps")
+                logger.warning(
+                    "run_resilient: non-finite step %d (loss %r, "
+                    "%d consecutive; guard %s)", step, last_loss,
+                    consecutive,
+                    "on" if obs.nanguard_enabled() else "OFF")
+                if consecutive >= escalate_after:
+                    if checkpoint_dir is not None:
+                        # under the guard the state still holds the last
+                        # good values — park them before dying
+                        _save()
+                    raise runtime.NonFiniteLossError(
+                        f"non-finite loss/gradients for {consecutive} "
+                        f"consecutive steps (through step {step}); last "
+                        "good step: "
+                        f"{last_good}. Params/optimizer state are held at "
+                        "the last good values"
+                        + (f" and checkpointed to {checkpoint_dir!r}"
+                           if checkpoint_dir else "")
+                        + (" (DETPU_NANGUARD=0: updates were NOT guarded "
+                           "— the saved state may be poisoned)"
+                           if not obs.nanguard_enabled() else ""))
+
+            # ---- metrics / escalations ----------------------------------
+            if metrics is not None:
+                if de is not None and de.ragged_overflow_raise:
+                    overflow = float(np.asarray(
+                        metrics["id_overflow"]).sum())
+                    if overflow > 0:
+                        raise runtime.InvalidInputError(
+                            f"step {step}: {int(overflow)} ragged id(s) "
+                            "overflowed their static capacity "
+                            "(ragged_overflow_raise)")
+                if (metrics_interval
+                        and step % metrics_interval == 0):
+                    host_metrics = obs.fetch_metrics(metrics)
+                    if metrics_logger is not None:
+                        metrics_logger.log_step(host_metrics, step=step)
+
+            if on_step is not None and on_step(step, last_loss, metrics,
+                                               state):
+                stop_reason = "on_step"
+                break
+
+            # ---- checkpoint cadence -------------------------------------
+            if checkpoint_dir is not None and not catcher.fired:
+                due_steps = (checkpoint_every_steps
+                             and (step + 1) % checkpoint_every_steps == 0)
+                due_time = (checkpoint_every_s
+                            and time.monotonic() - last_save_t
+                            >= checkpoint_every_s)
+                if due_steps or due_time:
+                    _save()
+
+            # ---- preemption: finish-step -> checkpoint -> sentinel ------
+            if catcher.fired:
+                preempted = True
+                stop_reason = "preempted"
+                if checkpoint_dir is not None:
+                    _save()
+                    _sentinel(True, step=int(state.step),
+                              signal=int(catcher.fired),
+                              reason="preempted")
+                break
+
+    elapsed = time.monotonic() - t0
+    if not preempted:
+        runtime.fault_point("driver.final")
+        if checkpoint_dir is not None and save_on_exit:
+            _save()
+        _sentinel(False)
+
+    result = ResilientResult(
+        state=state, step=int(state.step), steps_run=steps_run,
+        preempted=preempted, skipped_steps=skipped,
+        checkpoints_saved=saves, last_loss=last_loss,
+        stop_reason=stop_reason, elapsed_s=elapsed)
+    if preempted and exit_on_preempt and checkpoint_dir is not None:
+        # exit code 83 asserts "checkpointed, requeue me" — only true
+        # when a checkpoint dir exists; an uncheckpointed preemption
+        # returns normally so the caller can wind down gracefully
+        logger.warning(
+            "run_resilient: preempted at step %d — checkpointed, exiting "
+            "with code %d", result.step, PREEMPT_EXIT_CODE)
+        sys.exit(PREEMPT_EXIT_CODE)
+    return result

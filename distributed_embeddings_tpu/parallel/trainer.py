@@ -43,7 +43,8 @@ def _sq_sum(tree) -> jax.Array:
 
 
 def _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer, lr_schedule,
-                       state, cat_inputs, batch, with_metrics=False):
+                       state, cat_inputs, batch, with_metrics=False,
+                       nan_guard=False):
     """One per-device hybrid step (shared by :func:`make_hybrid_train_step`
     and :func:`make_hybrid_train_loop`): forward, one backward producing dp
     gradients (pmean-averaged) and mp cotangents (manual sparse path), both
@@ -52,6 +53,18 @@ def _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer, lr_schedule,
     ``with_metrics=True`` (static, trace-time) additionally returns the
     :data:`~..utils.obs.STEP_METRIC_KEYS` dict — the embedding layer's
     exchange/overflow metrics plus loss, grad norms, and the step counter.
+
+    ``nan_guard=True`` (static, trace-time; default follows
+    ``DETPU_NANGUARD``, which defaults ON) checks the loss and both
+    gradient energies for NaN/Inf *inside* the step and, on a non-finite
+    verdict, skips the dense AND sparse updates so params and optimizer
+    state come out bitwise-unchanged: the slab scatters route every row to
+    the dropped sentinel (O(ids) masking, never a slab-wide select) and
+    the small dense/aux leaves are ``where``-selected. The step counter
+    still advances (the poisoned batch is skipped, not retried), the
+    returned loss stays the true non-finite value so the host driver can
+    count consecutive skips and escalate, and under ``with_metrics`` the
+    per-device ``skipped_steps`` metric flags the skip.
     """
     world = de.world_size
     # slabs are {width: [world, rows, w]} globally -> [rows, w] per device
@@ -68,19 +81,53 @@ def _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer, lr_schedule,
         dense_grads = jax.tree.map(
             lambda g: resolve_dp_gradient(g, de.axis_name), dense_grads)
 
+    ok = None
+    if nan_guard:
+        with obs.scope("nanguard"):
+            # 0 * (local embedding-cotangent energy) is 0 when finite and
+            # NaN otherwise; the pmean propagates one device's verdict to
+            # every device so all ranks skip in LOCKSTEP — a half-applied
+            # step would desync the replicated dense params from the
+            # sharded slabs (the routed cotangent blocks carry the NaN to
+            # every rank's scatter anyway)
+            probe = jnp.float32(0.0) * _sq_sum(out_grads)
+            if world > 1:
+                probe = lax.pmean(probe, de.axis_name)
+            ok = (jnp.isfinite(loss.astype(jnp.float32))
+                  & jnp.isfinite(_sq_sum(dense_grads))
+                  & jnp.isfinite(probe))
+
     lr = lr_schedule(state.step) if callable(lr_schedule) else lr_schedule
     with obs.scope("sparse_apply"):
-        emb_local, emb_opt_local = de.sparse_apply_gradients(
-            emb_local, emb_opt_local, res, out_grads, emb_optimizer, lr)
+        new_emb, new_emb_opt = de.sparse_apply_gradients(
+            emb_local, emb_opt_local, res, out_grads, emb_optimizer, lr,
+            enable=ok)
 
     with obs.scope("dense_update"):
         updates, dense_opt_state = dense_tx.update(
             dense_grads, state.dense_opt_state, state.dense_params)
         dense_params = optax.apply_updates(state.dense_params, updates)
 
+    if nan_guard:
+        # slab-shaped leaves are already protected by the sentinel-gated
+        # scatters; only the small leaves need an explicit select — the
+        # dense params/opt state (MBs) and non-slab embedding-optimizer
+        # aux (Adam's step count), never the GB-scale slabs
+        slab_shapes = {tuple(v.shape) for v in emb_local.values()}
+
+        def sel(new, old):
+            return jax.tree.map(lambda n, o: jnp.where(ok, n, o), new, old)
+
+        new_emb_opt = jax.tree.map(
+            lambda n, o: (n if tuple(n.shape) in slab_shapes
+                          else jnp.where(ok, n, o)),
+            new_emb_opt, emb_opt_local)
+        dense_params = sel(dense_params, state.dense_params)
+        dense_opt_state = sel(dense_opt_state, state.dense_opt_state)
+
     new_state = HybridTrainState(
-        emb_params=de.stacked_view(emb_local),
-        emb_opt_state=de.stacked_view(emb_opt_local),
+        emb_params=de.stacked_view(new_emb),
+        emb_opt_state=de.stacked_view(new_emb_opt),
         dense_params=dense_params, dense_opt_state=dense_opt_state,
         step=state.step + 1)
     if not with_metrics:
@@ -93,6 +140,9 @@ def _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer, lr_schedule,
     metrics["dense_grad_norm"] = de._vary(
         jnp.sqrt(_sq_sum(dense_grads)).reshape(1))
     metrics["loss"] = de._vary(loss.astype(jnp.float32).reshape(1))
+    skipped = ((1 - ok.astype(jnp.int32)).reshape(1) if ok is not None
+               else jnp.zeros((1,), jnp.int32))
+    metrics["skipped_steps"] = de._vary(skipped)
     metrics["step"] = de._vary(state.step.astype(jnp.int32).reshape(1))
     return loss, new_state, metrics
 
@@ -115,7 +165,8 @@ def make_hybrid_train_step(de: DistributedEmbedding,
                            emb_optimizer,
                            mesh=None,
                            lr_schedule=1.0,
-                           with_metrics: Optional[bool] = None):
+                           with_metrics: Optional[bool] = None,
+                           nan_guard: Optional[bool] = None):
     """Build ``step(state, cat_inputs, batch) -> (loss, state)``.
 
     Args:
@@ -136,6 +187,13 @@ def make_hybrid_train_step(de: DistributedEmbedding,
         ragged-overflow counters, grad norms). ``None`` (default) follows
         ``DETPU_OBS=1``, so an uninstrumented run keeps the 2-tuple
         signature and pays nothing.
+      nan_guard: build the step with the on-device non-finite guard — a
+        NaN/Inf loss or gradient energy skips BOTH optimizer updates with
+        params and optimizer state bitwise-unchanged, advances the step
+        counter, returns the true (non-finite) loss, and flags
+        ``skipped_steps`` in the metrics. ``None`` (default) follows
+        ``DETPU_NANGUARD``, which defaults ON (see
+        :func:`~..utils.obs.nanguard_enabled`).
 
     The returned step takes data-parallel shards: each categorical input
     ``[local_batch, hotness]`` and ``batch`` any pytree of per-device arrays
@@ -144,11 +202,14 @@ def make_hybrid_train_step(de: DistributedEmbedding,
     world = de.world_size
     if with_metrics is None:
         with_metrics = obs.metrics_enabled()
+    if nan_guard is None:
+        nan_guard = obs.nanguard_enabled()
 
     def local_step(state: HybridTrainState, cat_inputs, batch):
         return _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer,
                                   lr_schedule, state, cat_inputs, batch,
-                                  with_metrics=with_metrics)
+                                  with_metrics=with_metrics,
+                                  nan_guard=nan_guard)
 
     if world == 1:
         return jax.jit(local_step, donate_argnums=(0,))
@@ -176,7 +237,8 @@ def make_hybrid_train_loop(de: DistributedEmbedding,
                            mesh=None,
                            lr_schedule=1.0,
                            unroll: int = 1,
-                           with_metrics: Optional[bool] = None):
+                           with_metrics: Optional[bool] = None,
+                           nan_guard: Optional[bool] = None):
     """Multi-step training driver: ``loop(state, cat_stacks, batch_stacks)
     -> (losses [K], state)`` running K steps inside ONE compiled program via
     ``lax.scan``.
@@ -194,17 +256,23 @@ def make_hybrid_train_loop(de: DistributedEmbedding,
     ``[K, b+1]``), ``batch`` any pytree with leading K.
 
     The per-step semantics (gradients, optimizer updates, step counter) are
-    exactly :func:`make_hybrid_train_step`'s — same ``local_step`` body.
+    exactly :func:`make_hybrid_train_step`'s — same ``local_step`` body,
+    non-finite guard included (``nan_guard``, default ``DETPU_NANGUARD``):
+    a poisoned batch inside the scan skips its own updates and the
+    remaining scanned steps proceed from the untouched state.
     """
     world = de.world_size
     if with_metrics is None:
         with_metrics = obs.metrics_enabled()
+    if nan_guard is None:
+        nan_guard = obs.nanguard_enabled()
 
     def body(state, xs):
         cat_inputs, batch = xs
         out = _hybrid_local_step(
             de, loss_fn, dense_tx, emb_optimizer, lr_schedule, state,
-            cat_inputs, batch, with_metrics=with_metrics)
+            cat_inputs, batch, with_metrics=with_metrics,
+            nan_guard=nan_guard)
         if with_metrics:
             loss, state, metrics = out
             return state, (loss, metrics)

@@ -4,14 +4,18 @@ entry points."""
 
 from . import obs, runtime
 from .checkpoint import (previous_checkpoint_path, restore_train_state,
-                         save_train_state, verify_checkpoint)
-from .data import DummyDataset, RawBinaryDataset, power_law_ids
+                         save_train_state, validate_checkpoint_model,
+                         verify_checkpoint)
+from .data import DummyDataset, RawBinaryDataset, fast_forward, power_law_ids
 from .metrics import binary_auc
 from .obs import (MetricsLogger, StepTimer, counter_inc, counters,
                   fetch_metrics, install_compile_listener,
-                  maybe_start_server, metrics_enabled, profile_trace,
-                  reset_counters, scope)
+                  maybe_start_server, metrics_enabled, nanguard_enabled,
+                  nanguard_escalation_k, profile_trace, reset_counters,
+                  scope)
 from .runtime import (BackendProbe, BackendUnavailable, CheckpointCorrupt,
-                      CoordinatorUnreachable, DeadlineExceeded, DeviceSpec,
-                      FaultInjected, SectionRecorder, deadline, fault_point,
-                      probe_backend, require_devices, retry, run_section)
+                      CheckpointMismatch, CoordinatorUnreachable,
+                      DeadlineExceeded, DeviceSpec, FaultInjected,
+                      InvalidInputError, NonFiniteLossError, SectionRecorder,
+                      deadline, fault_point, preempt_step, probe_backend,
+                      require_devices, retry, run_section)

@@ -191,6 +191,43 @@ def verify_checkpoint(path: str) -> Dict[str, Any]:
     return meta
 
 
+def validate_checkpoint_model(path: str, meta: Dict[str, Any], de) -> None:
+    """Check that a (whole, CRC-valid) checkpoint structurally matches the
+    model it is being restored into: table count and every table's
+    (vocab, dim) against ``de.strategy.global_configs``.
+
+    Raises :class:`~.runtime.CheckpointMismatch` naming the first
+    offending table with expected-vs-found shapes — the alternative is a
+    scatter-shape traceback from deep inside ``set_weights`` hours into a
+    resumed run. Shapes come from the ``tables`` manifest entry when
+    present; older checkpoints fall back to the ``.npy`` headers (an mmap
+    open reads only the header)."""
+    want = de.strategy.global_configs
+    n = int(meta.get("num_tables", -1))
+    if n != len(want):
+        raise runtime.CheckpointMismatch(
+            f"checkpoint at {path!r} holds {n} table(s) but the model "
+            f"declares {len(want)} — wrong checkpoint or changed model "
+            "config")
+    saved = meta.get("tables")
+    for t, cfg in enumerate(want):
+        exp = (int(cfg["input_dim"]), int(cfg["output_dim"]))
+        if saved is not None:
+            got = tuple(int(x) for x in saved[t])
+        else:
+            fp = os.path.join(path, "tables", f"table_{t:03d}.npy")
+            try:
+                got = tuple(np.load(fp, mmap_mode="r").shape)
+            except (OSError, ValueError) as e:
+                raise runtime.CheckpointCorrupt(
+                    f"cannot read table header {fp!r}: {e}") from e
+        if got != exp:
+            raise runtime.CheckpointMismatch(
+                f"table {t}: checkpoint at {path!r} was saved with "
+                f"vocab x dim {got}, the model expects {exp} — fix the "
+                "embedding configs or point at the matching checkpoint")
+
+
 def _is_slab_dict(tree, params) -> bool:
     """True when ``tree`` is a width-keyed dict of arrays shaped like the
     param slabs (Adagrad accumulators, momentum traces)."""
@@ -293,6 +330,11 @@ def save_train_state(path: str, de, state: HybridTrainState,
             return str(jnp.dtype(next(iter(tree.values())).dtype).name)
 
         meta = {"num_tables": n_tables,
+                # per-table (vocab, dim): lets restore reject a checkpoint
+                # that does not match the model with a named error instead
+                # of a scatter-shape traceback (CheckpointMismatch)
+                "tables": [[int(c["input_dim"]), int(c["output_dim"])]
+                           for c in de.strategy.global_configs],
                 "slab_components": sorted(slabs),
                 "aux_components": sorted(aux),
                 # per-component saved dtypes: a bf16-tables + fp32-accumulator
@@ -354,6 +396,9 @@ def restore_train_state(path: str, de, emb_optimizer, dense_template,
             "previous valid checkpoint at %s", path, e, prev)
         meta = verify_checkpoint(prev)  # must itself be whole, or we raise
         path = prev
+    # structural match BEFORE any data streams: a mismatched-but-whole
+    # checkpoint is a config error, not corruption — no .prev fallback
+    validate_checkpoint_model(path, meta, de)
     n = meta["num_tables"]
     saved_dtypes = meta.get("dtypes", {})
 

@@ -15,13 +15,42 @@ TPU equivalents of the reference's data layer (``examples/dlrm/utils.py``):
 
 from __future__ import annotations
 
+import itertools
 import math
 import os
 import queue
 import threading
-from typing import List, Optional, Sequence
+from typing import Any, Iterator, List, Optional, Sequence
 
 import numpy as np
+
+
+def fast_forward(data: Any, start: int) -> Iterator:
+    """Deterministically position a data source at batch ``start`` for a
+    resumed run — the resume contract: no batch replayed, none skipped.
+
+    Dispatch, cheapest first:
+
+    * a **callable** ``data(start) -> iterable`` positions itself (the
+      factory form; ``RawBinaryDataset(start_batch=...)`` or a seeded
+      generator that folds the step into its key);
+    * an object with ``iter_from(start)`` (e.g. :class:`RawBinaryDataset`)
+      seeks directly — random access via the memmaps, no replay cost;
+    * any other iterable is advanced with ``itertools.islice`` — the
+      skipped batches are *generated* and discarded (deterministic for a
+      seeded generator, but O(start) work; prefer the first two forms for
+      long runs).
+    """
+    if start < 0:
+        raise ValueError(f"fast_forward start must be >= 0, got {start}")
+    if callable(data):
+        return iter(data(start))
+    if hasattr(data, "iter_from"):
+        return data.iter_from(start)
+    it = iter(data)
+    if start:
+        next(itertools.islice(it, start - 1, start), None)
+    return it
 
 
 def get_categorical_feature_type(size: int):
@@ -181,9 +210,20 @@ class RawBinaryDataset:
             raise IndexError
         return self._read(idx)
 
+    def iter_from(self, start: int):
+        """Iterate from absolute batch ``start`` regardless of the
+        constructor's ``start_batch`` — the :func:`fast_forward` resume
+        hook (random access via the memmaps, no replay cost). Like
+        ``start_batch``, NOT wrapped modulo the epoch: resuming at or past
+        the end yields an empty stream."""
+        return self._iter_range(int(start))
+
     def __iter__(self):
+        return self._iter_range(self._start_batch)
+
+    def _iter_range(self, start_batch: int):
         if self._prefetch_depth <= 1:
-            for i in range(self._start_batch, self._num_entries):
+            for i in range(start_batch, self._num_entries):
                 yield self._read(i)
             return
 
@@ -210,7 +250,7 @@ class RawBinaryDataset:
             # the consumer — a silently dead producer would leave the
             # consumer blocked on q.get() forever.
             try:
-                for i in range(self._start_batch, self._num_entries):
+                for i in range(start_batch, self._num_entries):
                     if not put_until_stopped(self._read(i)):
                         return
                 put_until_stopped(None)

@@ -54,6 +54,8 @@ logger = logging.getLogger(__name__)
 OBS_ENV = "DETPU_OBS"
 PROFILE_DIR_ENV = "DETPU_PROFILE_DIR"
 PROFILE_PORT_ENV = "DETPU_PROFILE_PORT"
+NANGUARD_ENV = "DETPU_NANGUARD"
+NANGUARD_K_ENV = "DETPU_NANGUARD_K"
 
 #: Keys of the on-device step-metrics dict (a plain dict so it is a pytree
 #: without any registration, and JSON-serializable after a host fetch).
@@ -63,6 +65,7 @@ PROFILE_PORT_ENV = "DETPU_PROFILE_PORT"
 STEP_METRIC_KEYS = (
     "ids_routed",        # live (non-padding) ids this rank received
     "id_overflow",       # ragged ids lost to static-capacity truncation
+    "invalid_id_count",  # negative / out-of-vocab ids among the live ids
     "id_a2a_bytes",      # id-exchange bytes leaving this chip (dp->mp)
     "out_a2a_bytes",     # activation-exchange bytes leaving (mp->dp fwd)
     "grad_a2a_bytes",    # cotangent-exchange bytes leaving (dp->mp bwd)
@@ -70,6 +73,7 @@ STEP_METRIC_KEYS = (
     "loss",              # per-device loss (post-pmean: identical rows)
     "emb_grad_norm",     # L2 norm of this device's embedding cotangents
     "dense_grad_norm",   # L2 norm of the (averaged) dense gradient
+    "skipped_steps",     # 1 when the non-finite guard skipped this step
     "step",              # step counter at the START of the step
 )
 
@@ -79,6 +83,24 @@ def metrics_enabled() -> bool:
     can flip it at runtime; an env read is nanoseconds against a train
     step)."""
     return os.environ.get(OBS_ENV, "") not in ("", "0")
+
+
+def nanguard_enabled() -> bool:
+    """Whether the on-device non-finite guard is on. Default ON
+    (``DETPU_NANGUARD`` unset or truthy): a NaN/Inf batch must never
+    corrupt the sharded tables silently. Set ``DETPU_NANGUARD=0`` to build
+    the unguarded step. Read at step-build time (trace-time static), like
+    ``with_metrics``."""
+    return os.environ.get(NANGUARD_ENV, "1") not in ("", "0")
+
+
+def nanguard_escalation_k(default: int = 3) -> int:
+    """Consecutive guard-skipped steps before the host driver escalates
+    with :class:`~.runtime.NonFiniteLossError` (``DETPU_NANGUARD_K``)."""
+    try:
+        return int(os.environ.get(NANGUARD_K_ENV, default))
+    except ValueError:
+        return default
 
 
 # ------------------------------------------------------------- named scopes
@@ -286,10 +308,11 @@ def summarize(metrics: Dict[str, Any]) -> Dict[str, Any]:
         v = np.asarray(metrics[k]).reshape(-1)
         if v.size == 0:
             continue
-        if k in ("ids_routed", "id_a2a_bytes", "out_a2a_bytes",
-                 "grad_a2a_bytes"):
+        if k in ("ids_routed", "invalid_id_count", "id_a2a_bytes",
+                 "out_a2a_bytes", "grad_a2a_bytes"):
             out[k] = float(v.sum())
-        elif k in ("id_overflow", "out_pad_frac", "emb_grad_norm"):
+        elif k in ("id_overflow", "out_pad_frac", "emb_grad_norm",
+                   "skipped_steps"):
             out[k] = float(v.max())
         else:
             out[k] = float(v[0])

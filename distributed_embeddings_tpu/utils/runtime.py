@@ -91,6 +91,29 @@ class CheckpointCorrupt(RuntimeFault):
     manifest) and no fallback was available."""
 
 
+class CheckpointMismatch(RuntimeFault):
+    """A (whole, CRC-valid) checkpoint does not match the model it is being
+    restored into — wrong table count, or a table whose saved vocab/dim
+    disagrees with ``de.strategy.global_configs``. Raised by
+    ``utils.checkpoint.restore_train_state`` BEFORE any data streams, so a
+    config drift surfaces as one clear error instead of a scatter-shape
+    traceback deep inside ``set_weights``."""
+
+
+class InvalidInputError(RuntimeFault):
+    """An input batch violated the id contract (negative / out-of-vocab ids,
+    or a ragged batch whose claimed lengths overflow its static capacity)
+    under the ``'raise'`` invalid-id policy or the opt-in
+    ``ragged_overflow_raise`` escalation."""
+
+
+class NonFiniteLossError(RuntimeFault):
+    """The training loss stayed non-finite for K consecutive steps — the
+    on-device guard kept skipping updates (params untouched), and the host
+    driver escalates instead of spinning on a poisoned stream. The message
+    names the last good step."""
+
+
 class FaultInjected(RuntimeFault):
     """Raised by :func:`fault_point` under ``DETPU_FAULT=raise:<point>``."""
 
@@ -115,12 +138,32 @@ def _fault_specs() -> List[Tuple[str, str, Optional[str]]]:
         item = item.strip()
         if not item:
             continue
+        if item.startswith("preempt@"):
+            continue  # driver-level preemption drill: see preempt_step()
         parts = item.split(":", 2)
         if len(parts) < 2:
             logger.warning("ignoring malformed %s entry %r", FAULT_ENV, item)
             continue
         out.append((parts[0], parts[1], parts[2] if len(parts) > 2 else None))
     return out
+
+
+def preempt_step() -> Optional[int]:
+    """Step index of a ``DETPU_FAULT=preempt@<step>`` preemption drill, or
+    ``None``. At that step boundary the resilient driver
+    (``parallel.resilient.run_resilient``) delivers itself a real SIGTERM —
+    exercising the full preemption path (handler, finish the in-flight
+    step, checkpoint, resume sentinel) deterministically on CPU. Parsed per
+    call like the other fault specs, so tests can flip it at runtime."""
+    for item in os.environ.get(FAULT_ENV, "").split(","):
+        item = item.strip()
+        if not item.startswith("preempt@"):
+            continue
+        try:
+            return int(item.split("@", 1)[1])
+        except ValueError:
+            logger.warning("ignoring malformed %s entry %r", FAULT_ENV, item)
+    return None
 
 
 def fault_point(point: str) -> None:

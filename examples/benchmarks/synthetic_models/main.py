@@ -22,7 +22,8 @@ from absl import app, flags
 from distributed_embeddings_tpu.models import (
     InputGenerator, build_synthetic, synthetic_models_v3)
 from distributed_embeddings_tpu.parallel import (
-    SparseAdagrad, SparseSGD, init_hybrid_state, make_hybrid_train_step)
+    SparseAdagrad, SparseSGD, init_hybrid_state, make_hybrid_train_step,
+    run_resilient)
 
 FLAGS = flags.FLAGS
 flags.DEFINE_string("model", "tiny", "model scale from the zoo")
@@ -34,6 +35,20 @@ flags.DEFINE_integer("column_slice_threshold", None, "max elements per slice")
 flags.DEFINE_integer("row_cap", None,
                      "clip table vocab (zoo tables reach 2B rows)")
 flags.DEFINE_float("learning_rate", 0.01, "learning rate")
+flags.DEFINE_string("checkpoint_dir", None,
+                    "drive the run through the self-healing driver "
+                    "(parallel.resilient.run_resilient) with atomic "
+                    "train-state checkpoints in this directory; SIGTERM "
+                    "mid-run checkpoints and exits with the resume "
+                    "sentinel instead of losing the run")
+flags.DEFINE_bool("resume", False,
+                  "auto-resume from --checkpoint_dir when a valid "
+                  "checkpoint exists (preemption requeue)")
+flags.DEFINE_integer("checkpoint_every_steps", 0,
+                     "periodic checkpoint cadence for --checkpoint_dir "
+                     "(0 = only at exit/preemption)")
+
+_GEN_BATCHES = 4  # distinct pre-generated batches, cycled
 
 
 def main(_):
@@ -49,7 +64,7 @@ def main(_):
     print(de.strategy.describe())
 
     gen = InputGenerator(model_config, FLAGS.batch_size, alpha=FLAGS.alpha,
-                         num_batches=4, row_cap=FLAGS.row_cap)
+                         num_batches=_GEN_BATCHES, row_cap=FLAGS.row_cap)
     num0, cats0, _ = gen[0]
     out_widths = [
         int(de.strategy.global_configs[t]["output_dim"])
@@ -72,6 +87,30 @@ def main(_):
     step_fn = make_hybrid_train_step(de, loss_fn, tx, emb_opt, mesh=mesh,
                                      lr_schedule=FLAGS.learning_rate,
                                      with_metrics=False)
+
+    if FLAGS.checkpoint_dir:
+        # self-healing path: checkpointed, preemption-safe, resumable —
+        # the deterministic batch cycle makes an interrupted+resumed run
+        # reproduce the uninterrupted trajectory
+        def data(start):
+            for i in range(start, FLAGS.num_steps):
+                num, cats, labels = gen[i % _GEN_BATCHES]
+                yield cats, (num, labels)
+
+        t0 = time.perf_counter()
+        res = run_resilient(
+            step_fn, state, data, de=de,
+            checkpoint_dir=FLAGS.checkpoint_dir,
+            checkpoint_every_steps=FLAGS.checkpoint_every_steps,
+            resume=FLAGS.resume, emb_optimizer=emb_opt, dense_tx=tx,
+            mesh=mesh, exit_on_preempt=True)
+        dt = (time.perf_counter() - t0) / max(res.steps_run, 1)
+        print(f"{model_config.name}: {dt * 1e3:.3f} ms/iter over "
+              f"{res.steps_run} resilient step(s) to step {res.step} "
+              f"({res.checkpoints_saved} checkpoint(s)), final loss "
+              f"{res.last_loss:.5f}" if res.last_loss is not None else
+              f"{model_config.name}: resumed past the end (step {res.step})")
+        return
 
     # compile + warmup; float() readback drains the pipeline — on remote
     # tunnels block_until_ready can be a no-op (docs/perf_tpu.md Methodology)

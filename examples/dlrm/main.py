@@ -39,7 +39,7 @@ from distributed_embeddings_tpu.models.schedules import (
     warmup_poly_decay_schedule)
 from distributed_embeddings_tpu.parallel import (
     DistributedEmbedding, SparseSGD, bootstrap, init_hybrid_state,
-    make_hybrid_eval_step, make_hybrid_train_step)
+    make_hybrid_eval_step, make_hybrid_train_step, run_resilient)
 from distributed_embeddings_tpu.utils import (
     RawBinaryDataset, binary_auc, obs, power_law_ids)
 
@@ -86,6 +86,19 @@ flags.DEFINE_string("restore_state", None,
                     "(restores tables, sparse-optimizer state, dense "
                     "params/optimizer and the step counter; a torn "
                     "checkpoint falls back to <dir>.prev automatically)")
+flags.DEFINE_bool("resume", False,
+                  "auto-resume from --save_state when a valid checkpoint "
+                  "(or its .prev fallback) exists there — the "
+                  "preemption-requeue form of --restore_state (the run a "
+                  "SIGTERM checkpointed continues where it left off, no "
+                  "batch replayed or skipped)")
+flags.DEFINE_integer("checkpoint_interval", 0,
+                     "checkpoint the full train state to --save_state "
+                     "every N steps (0 = only at exit/preemption)")
+flags.DEFINE_float("checkpoint_time_s", 0,
+                   "also checkpoint when this much wall-clock passed "
+                   "since the last save (bounds work lost to preemption; "
+                   "0 = disabled)")
 flags.DEFINE_float("bootstrap_timeout_s", None,
                    "per-attempt deadline for the multi-host runtime join "
                    "(None = jax defaults); a slow coordinator is retried "
@@ -230,32 +243,42 @@ def main(_):
                        np.asarray(labels)[lb * pid:lb * (pid + 1)]))
         return jnp.asarray(num), jnp.asarray(labels)
 
+    def data_source(start):
+        """Batch stream positioned at absolute step ``start`` (the
+        resilient driver's resume contract: no batch replayed or
+        skipped), already prepped into ``(cat_inputs, batch)`` pairs."""
+        if FLAGS.dataset_path is not None:
+            # mp input reads full global batches per feature and packs
+            # them per-rank; on a multi-host launch each process would
+            # restrict categorical_features to its local ranks' tables
+            # (reference main.py:166-176). start_batch positions the
+            # memmap readers directly — no replay cost.
+            ds = RawBinaryDataset(
+                data_path=FLAGS.dataset_path, batch_size=FLAGS.batch_size,
+                numerical_features=FLAGS.num_numerical_features,
+                categorical_features=list(range(len(table_sizes))),
+                categorical_feature_sizes=table_sizes,
+                drop_last_batch=True, dp_input=not use_mp_input,
+                start_batch=start)
+            it = ((jnp.asarray(n), cs, jnp.asarray(y)) for n, cs, y in ds)
+        else:
+            import itertools
+            # seeded generation is deterministic: skipping the first
+            # ``start`` batches reproduces the uninterrupted stream
+            it = itertools.islice(
+                synthetic_batches(cfg, FLAGS.num_batches,
+                                  FLAGS.batch_size), start, None)
+        for num, cats, labels in it:
+            yield prep_cats(cats), prep_batch(num, labels)
+
     if FLAGS.dataset_path is not None:
-        # mp input reads full global batches per feature and packs them
-        # per-rank; on a multi-host launch each process would restrict
-        # categorical_features to its local ranks' tables (reference
-        # main.py:166-176).
-        train_data = RawBinaryDataset(
-            data_path=FLAGS.dataset_path, batch_size=FLAGS.batch_size,
-            numerical_features=FLAGS.num_numerical_features,
-            categorical_features=list(range(len(table_sizes))),
-            categorical_feature_sizes=table_sizes,
-            drop_last_batch=True, dp_input=not use_mp_input,
-            # resume continues the data stream where the checkpointed step
-            # left off (modulo epoch) instead of replaying early batches
-            # with a late-step LR
-            start_batch=int(state.step))
         eval_data = RawBinaryDataset(
             data_path=FLAGS.dataset_path, batch_size=FLAGS.batch_size,
             numerical_features=FLAGS.num_numerical_features,
             categorical_features=list(range(len(table_sizes))),
             categorical_feature_sizes=table_sizes,
             drop_last_batch=True, valid=True, dp_input=not use_mp_input)
-        train_iter = ((jnp.asarray(n), cs, jnp.asarray(y))
-                      for n, cs, y in train_data)
     else:
-        train_iter = synthetic_batches(cfg, FLAGS.num_batches,
-                                       FLAGS.batch_size)
         # a fixed held-out synthetic set so mid-training eval is meaningful
         eval_data = (list(synthetic_batches(cfg, FLAGS.eval_batches,
                                             FLAGS.batch_size, seed=1))
@@ -280,41 +303,52 @@ def main(_):
                           np.concatenate(all_preds))
 
     # flag-driven mid-training eval cadence with an MLPerf-style AUC stop
-    # target (VERDICT r3 Missing #3)
-    stopped = False
-    # resume numbers steps globally: the data stream already starts at
-    # state.step, so logging/eval cadence stays aligned with the
-    # uninterrupted run
-    for step, (num, cats, labels) in enumerate(train_iter,
-                                               start=int(state.step)):
-        if with_metrics:
-            loss, state, metrics = step_fn(state, prep_cats(cats),
-                                           prep_batch(num, labels))
-            if step % FLAGS.metrics_interval == 0:
-                # fetch_metrics is a COLLECTIVE on a pod (the [world]
-                # vectors span every process's devices): every process
-                # calls it, only the chief logs the fsynced record
-                host_metrics = obs.fetch_metrics(metrics)
-                if metrics_log is not None:
-                    metrics_log.log_step(host_metrics, step=step)
-        else:
-            loss, state = step_fn(state, prep_cats(cats),
-                                  prep_batch(num, labels))
+    # target (VERDICT r3 Missing #3), hosted in the resilient driver's
+    # per-step callback; resume numbers steps globally so logging/eval
+    # cadence stays aligned with the uninterrupted run
+
+    def on_step(step, loss, metrics, cur_state):
+        del metrics  # the driver already handles the metrics sidecar
         if step % 1000 == 0 and is_chief:
             print("step:", step, " loss:", float(loss))
         if (FLAGS.eval_interval and eval_data is not None and step
                 and step % FLAGS.eval_interval == 0):
-            auc = evaluate(state)
+            auc = evaluate(cur_state)
             if is_chief:
                 print(f"eval step: {step} AUC: {auc}")
             if FLAGS.auc_threshold is not None and auc >= FLAGS.auc_threshold:
                 if is_chief:
                     print(f"AUC threshold {FLAGS.auc_threshold} reached at "
                           f"step {step}, stopping")
-                stopped = True
-                break
+                return True
+        return False
 
-    if eval_data is not None and not stopped:
+    # The self-healing driver: periodic/wall-clock checkpoints to
+    # --save_state, SIGTERM/SIGINT -> finish step + checkpoint + exit 83
+    # (resume sentinel beside the checkpoint dir), --resume auto-restores
+    # and fast-forwards the data stream, K consecutive non-finite losses
+    # escalate with the last good step named.
+    result = run_resilient(
+        step_fn, state, data_source, de=de,
+        checkpoint_dir=FLAGS.save_state,
+        checkpoint_every_steps=FLAGS.checkpoint_interval,
+        checkpoint_every_s=FLAGS.checkpoint_time_s,
+        resume=FLAGS.resume,
+        emb_optimizer=emb_opt, dense_tx=tx, mesh=mesh,
+        metrics_logger=metrics_log,
+        metrics_interval=FLAGS.metrics_interval,
+        on_step=on_step,
+        # exit code 83 asserts "checkpointed, requeue me" — only true when
+        # a checkpoint dir exists; without one a SIGTERM just ends the
+        # loop and the script finishes gracefully (weights dump below)
+        exit_on_preempt=FLAGS.save_state is not None,
+        save_on_exit=FLAGS.save_state is not None,
+        is_chief=is_chief)
+    state = result.state
+
+    # an "on_step" stop is exactly the AUC-threshold early stop — the
+    # end-of-training eval is skipped like the pre-driver loop did
+    if eval_data is not None and result.stop_reason != "on_step":
         auc = evaluate(state)
         if is_chief:
             print(f"Evaluation completed, AUC: {auc}")
@@ -325,11 +359,9 @@ def main(_):
     if is_chief:
         np.savez(FLAGS.checkpoint_out, *weights)
         print("saved", len(weights), "tables to", FLAGS.checkpoint_out)
-    if FLAGS.save_state:
-        from distributed_embeddings_tpu.utils import save_train_state
-        save_train_state(FLAGS.save_state, de, state)
-        if is_chief:
-            print("saved full train state to", FLAGS.save_state)
+    if FLAGS.save_state and is_chief:
+        # the driver's save_on_exit already wrote it, atomically
+        print("saved full train state to", FLAGS.save_state)
     if metrics_log is not None:
         # final process-counter snapshot: recompiles, runtime retries,
         # fault injections — the "why was this run slow/odd" record

@@ -134,6 +134,163 @@ print("UNREACHABLE")
     assert got == pytest.approx(t0sum)  # v1 values, not the half-saved v2
 
 
+def test_checkpoint_mismatch_wrong_table_shape(tmp_path):
+    """A whole, CRC-valid checkpoint restored into a model with a drifted
+    table config must fail with CheckpointMismatch naming the table and
+    both shapes — not a scatter-shape traceback from set_weights."""
+    de, emb_opt, dp, tx, state = _tiny()
+    path = str(tmp_path / "ckpt")
+    save_train_state(path, de, state)
+    configs = [{"input_dim": 12 + 3 * i, "output_dim": 4} for i in range(3)]
+    configs[1]["input_dim"] = 99  # vocab drift on table 1
+    de2 = DistributedEmbedding(configs, world_size=1)
+    with pytest.raises(runtime.CheckpointMismatch,
+                       match=r"table 1.*\(15, 4\).*\(99, 4\)"):
+        restore_train_state(path, de2, emb_opt, dp, tx)
+
+
+def test_checkpoint_mismatch_wrong_table_count(tmp_path):
+    de, emb_opt, dp, tx, state = _tiny()
+    path = str(tmp_path / "ckpt")
+    save_train_state(path, de, state)
+    de2 = DistributedEmbedding(
+        [{"input_dim": 12 + 3 * i, "output_dim": 4} for i in range(2)],
+        world_size=1)
+    with pytest.raises(runtime.CheckpointMismatch, match="3 table"):
+        restore_train_state(path, de2, emb_opt, dp, tx)
+
+
+def test_checkpoint_mismatch_via_npy_headers(tmp_path):
+    """Checkpoints predating the ``tables`` manifest entry still validate
+    — shapes come from the .npy headers (an mmap open)."""
+    de, emb_opt, dp, tx, state = _tiny()
+    path = str(tmp_path / "ckpt")
+    save_train_state(path, de, state)
+    meta_path = os.path.join(path, "meta.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    del meta["tables"]
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    configs = [{"input_dim": 12 + 3 * i, "output_dim": 4} for i in range(3)]
+    configs[2]["output_dim"] = 8  # dim drift on table 2
+    de2 = DistributedEmbedding(configs, world_size=1)
+    with pytest.raises(runtime.CheckpointMismatch, match="table 2"):
+        restore_train_state(path, de2, emb_opt, dp, tx)
+    # and the matching model still restores fine without the entry
+    de3 = DistributedEmbedding(
+        [{"input_dim": 12 + 3 * i, "output_dim": 4} for i in range(3)],
+        world_size=1)
+    restore_train_state(path, de3, emb_opt, dp, tx)
+
+
+# -------------------------------------- driver fault-point recovery matrix
+
+# one resilient-driver run, 6 steps, checkpoint every 2: the recovery
+# contract is that DETPU_FAULT=die:<point> at ANY driver/checkpoint fault
+# point leaves on-disk state a restarted driver resumes from to the SAME
+# final step and loss as an uninterrupted run
+_DRIVER_CHILD = """
+import sys
+sys.path.insert(0, {repo!r})
+import jax, optax, numpy as np, jax.numpy as jnp
+jax.config.update('jax_platforms', 'cpu')
+from distributed_embeddings_tpu.parallel import (
+    DistributedEmbedding, SparseAdagrad, init_hybrid_state,
+    make_hybrid_train_step, run_resilient)
+configs = [{{"input_dim": 12 + 3 * i, "output_dim": 4}} for i in range(3)]
+de = DistributedEmbedding(configs, world_size=1)
+emb_opt = SparseAdagrad()
+tx = optax.sgd(0.1)
+state = init_hybrid_state(de, emb_opt,
+                          {{"w": jnp.ones((12, 1), jnp.float32)}},
+                          tx, jax.random.key(0))
+def loss_fn(dp, outs, batch):
+    x = sum(jnp.mean(o) for o in outs) * jnp.mean(dp["w"])
+    return (x - jnp.mean(batch)) ** 2
+def data(start):
+    for i in range(start, 6):
+        rng = np.random.default_rng(100 + i)
+        cats = [jnp.asarray(rng.integers(0, c["input_dim"], 8), jnp.int32)
+                for c in configs]
+        yield cats, jnp.asarray(rng.normal(size=(8,)), jnp.float32)
+step = make_hybrid_train_step(de, loss_fn, tx, emb_opt,
+                              with_metrics=False, nan_guard=True)
+r = run_resilient(step, state, data, de=de, checkpoint_dir={ckpt!r},
+                  checkpoint_every_steps=2, resume=True,
+                  emb_optimizer=emb_opt, dense_tx=tx,
+                  exit_on_preempt=True)
+print("FINAL", r.step)
+"""
+
+DRIVER_FAULT_POINTS = ("driver.step", "driver.save", "checkpoint_write",
+                       "checkpoint_commit", "driver.final")
+
+
+def _run_driver_child(ckpt, fault=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DETPU_FAULT", None)
+    if fault:
+        env["DETPU_FAULT"] = fault
+    code = _DRIVER_CHILD.format(repo=_REPO, ckpt=ckpt)
+    return subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=300)
+
+
+_REFERENCE_FINAL = {}
+
+
+def _final_crcs(ckpt):
+    """Content CRCs of a final checkpoint — tables, every optimizer
+    component, dense.msgpack (step included): bitwise run equivalence."""
+    with open(os.path.join(ckpt, "meta.json")) as f:
+        return json.load(f)["files"]
+
+
+def _reference_final(tmp_factory):
+    """Uninterrupted run's final checkpoint CRCs, computed once."""
+    if not _REFERENCE_FINAL:
+        ckpt = os.path.join(str(tmp_factory.mktemp("ref")), "ck")
+        proc = _run_driver_child(ckpt)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "FINAL 6" in proc.stdout, proc.stdout
+        _REFERENCE_FINAL["crcs"] = _final_crcs(ckpt)
+    return _REFERENCE_FINAL["crcs"]
+
+
+@pytest.mark.parametrize("point", DRIVER_FAULT_POINTS)
+def test_driver_die_at_fault_point_then_restart_recovers(
+        tmp_path, tmp_path_factory, point):
+    """DETPU_FAULT=die:<point> kills the child driver at that point; a
+    restarted driver (resume=True) must end with a final checkpoint
+    CRC-identical to the uninterrupted run's — no torn state, no lost or
+    replayed batch."""
+    ckpt = str(tmp_path / "ck")
+    p1 = _run_driver_child(ckpt, fault=f"die:{point}")
+    assert p1.returncode == 17, (point, p1.stderr[-2000:])
+    p2 = _run_driver_child(ckpt)
+    assert p2.returncode == 0, (point, p2.stderr[-2000:])
+    assert "FINAL 6" in p2.stdout, (point, p2.stdout)
+    assert _final_crcs(ckpt) == _reference_final(tmp_path_factory), point
+
+
+def test_driver_die_at_resume_then_restart_recovers(
+        tmp_path, tmp_path_factory):
+    """The resume path itself is a fault point: preempt a run (checkpoint
+    exists), die inside the next run's restore, then restart clean."""
+    ckpt = str(tmp_path / "ck")
+    p1 = _run_driver_child(ckpt, fault="preempt@2")
+    from distributed_embeddings_tpu.parallel import PREEMPT_EXIT_CODE
+    assert p1.returncode == PREEMPT_EXIT_CODE, p1.stderr[-2000:]
+    assert os.path.exists(ckpt + ".resume.json")
+    p2 = _run_driver_child(ckpt, fault="die:driver.resume")
+    assert p2.returncode == 17, p2.stderr[-2000:]
+    p3 = _run_driver_child(ckpt)
+    assert p3.returncode == 0, p3.stderr[-2000:]
+    assert "FINAL 6" in p3.stdout, p3.stdout
+    assert _final_crcs(ckpt) == _reference_final(tmp_path_factory)
+
+
 def test_pre_crc_checkpoints_still_restore(tmp_path):
     """Old-format checkpoints (no ``files`` manifest) predate validation:
     they load with a debug note instead of failing."""

@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Verify gate for the self-healing driver (run by ``make verify``).
+
+CPU end-to-end preemption drill, with a REAL external SIGTERM (not just
+the in-process ``preempt@`` drill the unit tests use):
+
+1. spawn a child training driver (tiny model, 12 slow-ish steps through
+   ``parallel.resilient.run_resilient`` with checkpointing);
+2. once the child reports a few completed steps, send it SIGTERM — the
+   child must finish the in-flight step, checkpoint atomically, write the
+   resume sentinel, and exit with ``PREEMPT_EXIT_CODE``;
+3. relaunch the same command — it must auto-resume from the checkpoint
+   (no batch replayed or skipped) and run to completion;
+4. run the identical, uninterrupted driver in a fresh directory and
+   assert both end at the same final step with CRC-identical final
+   checkpoints (tables, optimizer components, dense state incl. the step
+   counter) — the interrupted-run-equivalence acceptance criterion.
+
+Exit 0 when the drill passes; 1 with a readable reason otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STEPS = 12
+SIGTERM_AFTER_STEP = 3  # parent fires once the child reports this step
+
+_CHILD = """
+import sys, time
+sys.path.insert(0, {repo!r})
+import jax, optax, numpy as np, jax.numpy as jnp
+jax.config.update('jax_platforms', 'cpu')
+from distributed_embeddings_tpu.parallel import (
+    DistributedEmbedding, SparseAdagrad, init_hybrid_state,
+    make_hybrid_train_step, run_resilient)
+configs = [{{"input_dim": 16 + 3 * i, "output_dim": 4}} for i in range(4)]
+de = DistributedEmbedding(configs, world_size=1)
+emb_opt = SparseAdagrad()
+tx = optax.sgd(0.1)
+state = init_hybrid_state(de, emb_opt,
+                          {{"w": jnp.ones((4, 1), jnp.float32)}},
+                          tx, jax.random.key(0))
+def loss_fn(dp, outs, batch):
+    x = sum(jnp.mean(o) for o in outs) * jnp.mean(dp["w"])
+    return (x - jnp.mean(batch)) ** 2
+def data(start):
+    for i in range(start, {steps}):
+        rng = np.random.default_rng(500 + i)
+        cats = [jnp.asarray(rng.integers(0, c["input_dim"], 8), jnp.int32)
+                for c in configs]
+        yield cats, jnp.asarray(rng.normal(size=(8,)), jnp.float32)
+step = make_hybrid_train_step(de, loss_fn, tx, emb_opt,
+                              with_metrics=False, nan_guard=True)
+def on_step(s, loss, metrics, st):
+    print("RSTEP", s, flush=True)
+    time.sleep({sleep})  # widen the SIGTERM window; a real step is not 0ms
+    return False
+r = run_resilient(step, state, data, de=de, checkpoint_dir={ckpt!r},
+                  checkpoint_every_steps=2, resume=True,
+                  emb_optimizer=emb_opt, dense_tx=tx, on_step=on_step,
+                  exit_on_preempt=True)
+print("FINAL", r.step, flush=True)
+"""
+
+
+def _spawn(ckpt, sleep=0.0):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DETPU_FAULT", None)
+    code = _CHILD.format(repo=REPO, ckpt=ckpt, steps=STEPS, sleep=sleep)
+    # stderr merged into stdout: phase 1 reads stdout line-by-line, and a
+    # separate never-drained stderr pipe could fill and deadlock a
+    # stderr-heavy child
+    return subprocess.Popen([sys.executable, "-c", code], env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+
+
+def _drain(proc, timeout=600):
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        return None, out
+    return proc.returncode, out
+
+
+def _final_crcs(ckpt):
+    with open(os.path.join(ckpt, "meta.json"), encoding="utf-8") as f:
+        return json.load(f)["files"]
+
+
+def main() -> int:
+    from distributed_embeddings_tpu.parallel.resilient import (
+        PREEMPT_EXIT_CODE)
+
+    errors = []
+    with tempfile.TemporaryDirectory(prefix="detpu_resilience_") as tmp:
+        ckpt = os.path.join(tmp, "ck")
+        ref_ckpt = os.path.join(tmp, "ref")
+
+        # 1+2: spawn, SIGTERM once a few steps completed. The line reader
+        # runs under a SIGALRM watchdog: a wedged child must fail the
+        # gate with a diagnostic, not hang `make verify` forever.
+        proc = _spawn(ckpt, sleep=0.2)
+        fired = False
+
+        def _watchdog(signum, frame):
+            raise TimeoutError
+
+        old = signal.signal(signal.SIGALRM, _watchdog)
+        signal.alarm(600)
+        try:
+            for line in proc.stdout:
+                if line.startswith("RSTEP"):
+                    step = int(line.split()[1])
+                    if step >= SIGTERM_AFTER_STEP and not fired:
+                        proc.send_signal(signal.SIGTERM)
+                        fired = True
+                if line.startswith("FINAL"):
+                    break
+        except TimeoutError:
+            proc.kill()
+            _drain(proc, timeout=10)
+            return _fail(["phase-1 child produced no progress for 600s "
+                          "(wedged step?) — killed"])
+        finally:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old)
+        rc, out = _drain(proc)
+        if not fired:
+            return _fail(["child finished before the SIGTERM window — "
+                          "raise STEPS or the per-step sleep"])
+        if rc != PREEMPT_EXIT_CODE:
+            return _fail([f"preempted child exited rc={rc} (want "
+                          f"{PREEMPT_EXIT_CODE}): {out.strip()[-500:]}"])
+        if not os.path.exists(ckpt + ".resume.json"):
+            return _fail(["preempted child left no resume sentinel"])
+
+        # 3: relaunch -> auto-resume -> completion
+        rc, out = _drain(_spawn(ckpt))
+        if rc != 0:
+            return _fail([f"resumed child failed rc={rc}: "
+                          f"{out.strip()[-500:]}"])
+        if f"FINAL {STEPS}" not in out:
+            errors.append(f"resumed child did not reach step {STEPS}: "
+                          f"{out.splitlines()[-3:]}")
+        resumed_first = [int(line.split()[1]) for line in out.splitlines()
+                         if line.startswith("RSTEP")][:1]
+        if resumed_first and resumed_first[0] <= SIGTERM_AFTER_STEP:
+            errors.append(
+                f"resume replayed step {resumed_first[0]} — the "
+                "checkpointed steps must not re-train")
+        if os.path.exists(ckpt + ".resume.json"):
+            errors.append("completed run left the resume sentinel behind")
+
+        # 4: uninterrupted reference must match bit for bit
+        rc, out = _drain(_spawn(ref_ckpt))
+        if rc != 0:
+            return _fail([f"reference child failed rc={rc}: "
+                          f"{out.strip()[-500:]}"])
+        if not errors and _final_crcs(ckpt) != _final_crcs(ref_ckpt):
+            errors.append(
+                "final checkpoints differ between the interrupted+resumed "
+                "run and the uninterrupted run (CRC manifests unequal) — "
+                "resume is not trajectory-exact")
+    if errors:
+        return _fail(errors)
+    print("check_resilience: OK (SIGTERM'd child checkpointed + exited "
+          f"{PREEMPT_EXIT_CODE}, resumed to step {STEPS}, final state "
+          "CRC-identical to the uninterrupted run)")
+    return 0
+
+
+def _fail(errors) -> int:
+    for e in errors:
+        print(f"check_resilience: {e}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

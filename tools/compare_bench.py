@@ -44,6 +44,8 @@ THROUGHPUT_KEYS = (
     "multihot_ragged_samples_per_sec",
     "criteo1tb_shard_samples_per_sec",
     "input_pipeline_samples_per_sec",
+    "nanguard_samples_per_sec",
+    "resilient_samples_per_sec",
 )
 # lower is better
 MS_KEYS = (
