@@ -4,7 +4,7 @@
 SHELL := /bin/bash
 
 .PHONY: all native test test-fast bench bench-diff clean pkg verify \
-        check-backend check-obs check-resilience
+        lint audit-step check-backend check-obs check-resilience
 
 all: native
 
@@ -23,9 +23,10 @@ bench:
 	python bench.py
 
 # the driver's tier-1 gate (ROADMAP.md "Tier-1 verify", verbatim semantics)
-# plus the static no-eager-backend check, the observability gate, and the
+# plus the static gates (detlint rules, the SPMD step auditor, the legacy
+# no-eager-backend shim), the observability gate, and the
 # preemption-recovery drill — run before shipping a round
-verify: check-backend check-obs check-resilience
+verify: lint audit-step check-backend check-obs check-resilience
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -34,8 +35,19 @@ verify: check-backend check-obs check-resilience
 	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
 	exit $$rc
 
+# unified AST lint framework: eager-backend, env-registry, bare-except,
+# host-fetch, named-scope-exchange, module-scope-jax (tools/detlint/)
+lint:
+	python -m tools.detlint
+
+# SPMD invariant auditor: traces the hybrid step abstractly on an
+# 8-virtual-device CPU mesh and enforces the communication contract
+# (2 fwd + 1 bwd all-to-all, no all_gather, no f64, donations intact)
+audit-step:
+	env JAX_PLATFORMS=cpu python tools/audit_step.py --strict
+
 # fails if __graft_entry__.py / bench.py reintroduce a pre-probe backend
-# touch (the r5 rc=124 root cause)
+# touch (the r5 rc=124 root cause); thin shim over the detlint rule
 check-backend:
 	python tools/check_no_eager_backend.py
 

@@ -21,4 +21,19 @@ __all__ = [
     "row_to_split",
     "Ragged",
     "SparseIds",
+    "AuditReport",
+    "audit_train_step",
 ]
+
+_ANALYSIS_EXPORTS = ("AuditReport", "audit_train_step")
+
+
+def __getattr__(name):
+    # the step auditor pulls in the whole parallel stack (flax/optax);
+    # loaded lazily so `import distributed_embeddings_tpu` stays light
+    if name in _ANALYSIS_EXPORTS:
+        from . import analysis
+
+        return getattr(analysis, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

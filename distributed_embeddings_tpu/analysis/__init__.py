@@ -1,0 +1,26 @@
+"""Static analysis of compiled SPMD train steps.
+
+The hybrid-parallel design is a *communication contract* — exactly one id
+all-to-all and one output all-to-all forward, one cotangent all-to-all
+backward — and this package verifies it by abstract interpretation
+(jaxpr/StableHLO inspection, no backend execution) instead of by reading
+throughput numbers after the fact. See :mod:`.audit`.
+"""
+
+from .audit import (
+    AuditError,
+    AuditReport,
+    CollectiveRecord,
+    audit_step_fn,
+    audit_train_step,
+    expected_collectives,
+)
+
+__all__ = [
+    "AuditError",
+    "AuditReport",
+    "CollectiveRecord",
+    "audit_step_fn",
+    "audit_train_step",
+    "expected_collectives",
+]

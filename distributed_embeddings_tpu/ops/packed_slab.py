@@ -25,12 +25,13 @@ Tables with ``width >= 128`` keep their natural layout (``p == 1``).
 
 from __future__ import annotations
 
-import os
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..utils import envvars
 
 LANES = 128
 
@@ -42,8 +43,10 @@ LANES = 128
 # a where/select chain that touches only the addressed lane, isolating
 # non-finite rows exactly. Slower (~1.8x on the extract step) — debugging
 # only, never needed for training health.
-DEBUG_LANE_EXTRACT = bool(int(os.environ.get(
-    "DETPU_DEBUG_LANE_EXTRACT", "0")))
+# int() parse kept deliberately loud: a debug knob set to a typo ("false",
+# "off") must fail at import, not silently flip the ~1.8x-slower extract
+# path on and surface as an unexplained bench regression
+DEBUG_LANE_EXTRACT = bool(int(envvars.get("DETPU_DEBUG_LANE_EXTRACT")))
 
 
 def pack_factor(width: int) -> int:

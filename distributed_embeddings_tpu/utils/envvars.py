@@ -1,0 +1,152 @@
+"""Single registry of every ``DETPU_*`` environment variable.
+
+The knob surface grew one env read at a time (``DETPU_OBS``,
+``DETPU_FAULT``, ``DETPU_BENCH_SMOKE``, ...) with no one place that says
+what exists, what the default is, or what a value means — and nothing
+stopping a typo'd ``os.environ.get("DETPU_OBSS")`` from shipping as a
+silently-dead knob. This module is that place: every ``DETPU_*`` variable
+is :func:`declare`'d here with its default and one-line meaning, and the
+``env-registry`` detlint rule (``tools/detlint/rules/env_registry.py``)
+fails the build on any ``DETPU_*`` env read whose name is not registered.
+
+Reads may keep using ``os.environ`` directly with a registered name (the
+lint rule resolves literals and module-level ``X_ENV = "DETPU_X"``
+constants), or go through :func:`get`/:func:`enabled`/:func:`get_float`,
+which also raise loudly on an undeclared name at run time.
+
+Like the rest of :mod:`..utils`'s host-side layer, this module never
+imports jax: the registry must be readable by pure-AST tooling and by
+processes that never load a backend.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, NamedTuple, Optional
+
+
+class EnvVar(NamedTuple):
+    """One registered knob: its default (``None`` = unset) and meaning."""
+    name: str
+    default: Optional[str]
+    doc: str
+
+
+_REGISTRY: Dict[str, EnvVar] = {}
+
+
+def declare(name: str, default: Optional[str] = None, doc: str = "") -> str:
+    """Register one ``DETPU_*`` variable; returns the name so call sites
+    can do ``FOO_ENV = declare("DETPU_FOO", ...)``. Declarations live in
+    this module (below) so the detlint rule can extract the full set from
+    the AST without importing anything."""
+    _REGISTRY[name] = EnvVar(name, default, doc)
+    return name
+
+
+def registered() -> Dict[str, EnvVar]:
+    """Snapshot of the full registry (name -> :class:`EnvVar`)."""
+    return dict(_REGISTRY)
+
+
+def _require(name: str) -> EnvVar:
+    spec = _REGISTRY.get(name)
+    if spec is None:
+        raise KeyError(
+            f"{name!r} is not a registered DETPU env var — declare it in "
+            "distributed_embeddings_tpu/utils/envvars.py (the env-registry "
+            "lint rule would reject the read anyway)")
+    return spec
+
+
+def get(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Read a registered variable; ``default`` overrides the declared
+    default for this one call (tests and shims occasionally need that)."""
+    spec = _require(name)
+    fallback = spec.default if default is None else default
+    return os.environ.get(name, fallback)
+
+
+def enabled(name: str) -> bool:
+    """Truthy read with the repo-wide convention: unset-with-falsy-default,
+    empty, and ``"0"`` are off; anything else is on."""
+    v = get(name)
+    return v not in (None, "", "0")
+
+
+def get_float(name: str, default: Optional[float] = None) -> float:
+    """Float read of a registered variable; a malformed value falls back
+    to the default instead of crashing a training run over a typo."""
+    spec = _require(name)
+    fb = default if default is not None else float(spec.default or 0.0)
+    try:
+        return float(os.environ.get(name, fb))
+    except (TypeError, ValueError):
+        return fb
+
+
+def get_int(name: str, default: Optional[int] = None) -> int:
+    """Int read of a registered variable (same fallback policy as
+    :func:`get_float`)."""
+    spec = _require(name)
+    fb = default if default is not None else int(spec.default or 0)
+    try:
+        return int(os.environ.get(name, fb))
+    except (TypeError, ValueError):
+        return fb
+
+
+# --------------------------------------------------------------------------
+# The registry. One declare() per knob, literal names only (the lint rule
+# reads these calls from the AST). Keep alphabetical within each block.
+# --------------------------------------------------------------------------
+
+# observability (utils/obs.py)
+declare("DETPU_OBS", default="",
+        doc="1 = build train steps with on-device step metrics (3-tuple "
+            "return) and emit metrics sidecars")
+declare("DETPU_OBS_SIDECAR", default="BENCH.metrics.jsonl",
+        doc="path of the step-metrics JSONL sidecar bench.py writes under "
+            "DETPU_OBS=1")
+declare("DETPU_PROFILE_DIR", default=None,
+        doc="directory for XLA profile captures (obs.profile_trace); "
+            "unset = no capture")
+declare("DETPU_PROFILE_PORT", default=None,
+        doc="port for a live jax profiler server (obs.maybe_start_server); "
+            "unset = no server")
+
+# non-finite guard (utils/obs.py + parallel/trainer.py + resilient.py)
+declare("DETPU_NANGUARD", default="1",
+        doc="on-device non-finite guard in the hybrid step; 0 = build the "
+            "unguarded step")
+declare("DETPU_NANGUARD_K", default="3",
+        doc="consecutive guard-skipped steps before the resilient driver "
+            "escalates NonFiniteLossError")
+
+# fault injection + runtime probes (utils/runtime.py)
+declare("DETPU_FAULT", default="",
+        doc="comma-separated fault injections: hang|slow|raise|die:<point> "
+            "or preempt@<step>")
+declare("DETPU_PROBE_TIMEOUT_S", default="120",
+        doc="time box (seconds) for the subprocess backend probe")
+declare("DETPU_DRYRUN_TIMEOUT_S", default="600",
+        doc="time box (seconds) for the __graft_entry__ dryrun child")
+declare("_DETPU_DRYRUN_CHILD", default=None,
+        doc="internal: set in the dryrun child's environment so it knows "
+            "to touch the backend directly")
+
+# bench.py
+declare("DETPU_BENCH_SMOKE", default="",
+        doc="1 = shrink every bench shape to smoke-test size")
+declare("DETPU_BENCH_SIDECAR", default="BENCH.partial.jsonl",
+        doc="path of bench.py's crash-surviving per-section JSONL sidecar")
+declare("DETPU_BENCH_SECTION_DEADLINE_S", default="1200",
+        doc="best-effort SIGALRM deadline (seconds) per bench section")
+
+# debug / test harness
+declare("DETPU_DEBUG_LANE_EXTRACT", default="0",
+        doc="1 = swap the packed-slab lane extraction for the reference "
+            "gather (ops/packed_slab.py divergence debugging)")
+declare("DETPU_FORCE_CPU_DEVICES", default=None,
+        doc="N = examples force JAX_PLATFORMS=cpu with N virtual host "
+            "devices (test harness for the example mains)")

@@ -47,10 +47,14 @@ import threading
 import time
 from typing import Any, Dict, Iterator, Optional
 
+from . import envvars
 from . import runtime as _runtime
 
 logger = logging.getLogger(__name__)
 
+# names stay importable as module constants; the knobs themselves are
+# declared (default + meaning) in utils/envvars.py, the single registry
+# the env-registry lint rule enforces
 OBS_ENV = "DETPU_OBS"
 PROFILE_DIR_ENV = "DETPU_PROFILE_DIR"
 PROFILE_PORT_ENV = "DETPU_PROFILE_PORT"
@@ -82,7 +86,7 @@ def metrics_enabled() -> bool:
     """Whether ``DETPU_OBS`` asks for step metrics (read per call so tests
     can flip it at runtime; an env read is nanoseconds against a train
     step)."""
-    return os.environ.get(OBS_ENV, "") not in ("", "0")
+    return envvars.enabled(OBS_ENV)
 
 
 def nanguard_enabled() -> bool:
@@ -91,16 +95,13 @@ def nanguard_enabled() -> bool:
     corrupt the sharded tables silently. Set ``DETPU_NANGUARD=0`` to build
     the unguarded step. Read at step-build time (trace-time static), like
     ``with_metrics``."""
-    return os.environ.get(NANGUARD_ENV, "1") not in ("", "0")
+    return envvars.enabled(NANGUARD_ENV)
 
 
 def nanguard_escalation_k(default: int = 3) -> int:
     """Consecutive guard-skipped steps before the host driver escalates
     with :class:`~.runtime.NonFiniteLossError` (``DETPU_NANGUARD_K``)."""
-    try:
-        return int(os.environ.get(NANGUARD_K_ENV, default))
-    except ValueError:
-        return default
+    return envvars.get_int(NANGUARD_K_ENV, default)
 
 
 # ------------------------------------------------------------- named scopes
@@ -124,7 +125,7 @@ def profile_trace(label: Optional[str] = None) -> Iterator[None]:
     ``label`` names a subdirectory so successive captures (e.g. one per
     bench section) do not overwrite each other.
     """
-    base = os.environ.get(PROFILE_DIR_ENV)
+    base = envvars.get(PROFILE_DIR_ENV)
     if not base:
         yield
         return
@@ -145,7 +146,7 @@ def maybe_start_server() -> bool:
     process (for live TensorBoard capture); no-op without the variable.
     Returns whether a server is running after the call."""
     global _server_started
-    port = os.environ.get(PROFILE_PORT_ENV)
+    port = envvars.get(PROFILE_PORT_ENV)
     if not port:
         return _server_started
     with _server_lock:

@@ -53,6 +53,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from . import envvars
+
 logger = logging.getLogger(__name__)
 
 FAULT_ENV = "DETPU_FAULT"
@@ -134,7 +136,7 @@ def _fault_specs() -> List[Tuple[str, str, Optional[str]]]:
     """Parse ``DETPU_FAULT`` (read at every call so tests can flip it at
     runtime): comma-separated ``mode:point[:arg]`` entries."""
     out = []
-    for item in os.environ.get(FAULT_ENV, "").split(","):
+    for item in (envvars.get(FAULT_ENV) or "").split(","):
         item = item.strip()
         if not item:
             continue
@@ -155,7 +157,7 @@ def preempt_step() -> Optional[int]:
     exercising the full preemption path (handler, finish the in-flight
     step, checkpoint, resume sentinel) deterministically on CPU. Parsed per
     call like the other fault specs, so tests can flip it at runtime."""
-    for item in os.environ.get(FAULT_ENV, "").split(","):
+    for item in (envvars.get(FAULT_ENV) or "").split(","):
         item = item.strip()
         if not item.startswith("preempt@"):
             continue

@@ -31,3 +31,20 @@ jax.config.update("jax_enable_x64", False)
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running / memory-heavy tests")
+
+
+import pytest  # noqa: E402 - after the backend-forcing block above
+
+
+@pytest.fixture
+def transfer_guard():
+    """Opt-in: fail the test on any IMPLICIT host<->device transfer inside
+    it (``jax.transfer_guard("disallow")``). Trainer / dist-embedding step
+    tests use this to prove the jitted step never smuggles a hidden
+    device->host readback or a per-step host constant upload — the same
+    property the step auditor checks statically (analysis/audit.py), here
+    enforced at run time. Explicit transfers (``jax.device_put``, committed
+    input staging, ``np.asarray`` readbacks the test itself does) stay
+    allowed."""
+    with jax.transfer_guard("disallow"):
+        yield

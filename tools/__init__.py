@@ -1,0 +1,2 @@
+# makes `python -m tools.detlint` work from the repo root; the individual
+# tools stay directly runnable as scripts

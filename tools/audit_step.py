@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Audit the hybrid train step's SPMD invariants on a CPU mesh.
+
+Builds reference DistributedEmbedding configurations (dense, ragged,
+row-sliced — the same shapes the tier-1 tests pin), traces the hybrid
+train step abstractly on an N-virtual-device CPU mesh, and prints each
+:class:`~distributed_embeddings_tpu.analysis.AuditReport`: the collective
+census checked against the 2-forward + 1-backward all-to-all contract,
+dtype/host-interop/donation audits, and recompile hazards. Nothing
+executes on any backend — ``jax.make_jaxpr`` + ``jit(...).lower()`` only.
+
+    python tools/audit_step.py --strict          # make verify's gate
+    python tools/audit_step.py --json report.json --config ragged
+
+Exit codes: 0 clean; 1 violations found (only with ``--strict``);
+2 usable-environment failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _force_cpu(devices: int) -> None:
+    """Must run before the first jax import: the auditor is a pure static
+    tool and must never touch (or wait on) an accelerator backend."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={devices}")
+    # an inherited DETPU_OBS=1 would flip the audited step to the
+    # instrumented variant; audit both shapes explicitly instead
+    os.environ.pop("DETPU_OBS", None)
+
+
+def build_case(name: str, world: int, batch: int):
+    """One reference configuration: ``(de, cat_inputs, batch_tree,
+    dense_params, loss_fn)`` with abstract (ShapeDtypeStruct) inputs."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_embeddings_tpu.ops.embedding_lookup import Ragged
+    from distributed_embeddings_tpu.parallel import DistributedEmbedding
+
+    def loss_fn(dp, emb_outs, b):
+        n, y = b
+        x = jnp.concatenate([e.reshape(e.shape[0], -1) for e in emb_outs],
+                            axis=1)
+        return jnp.mean((x @ dp["w"] + n @ dp["v"] - y) ** 2)
+
+    if name == "dense":
+        configs = [{"input_dim": 20 + 6 * i, "output_dim": 4,
+                    "combiner": ["sum", None, "mean"][i % 3]}
+                   for i in range(10)]
+        de = DistributedEmbedding(configs, world_size=world)
+        cats = []
+        for cfg in configs:
+            hot = 1 if cfg["combiner"] is None else 3
+            shape = (batch,) if hot == 1 else (batch, hot)
+            cats.append(jax.ShapeDtypeStruct(shape, jnp.int32))
+    elif name == "ragged":
+        configs = [{"input_dim": 40 + 7 * i, "output_dim": 8,
+                    "combiner": "sum" if i % 2 else "mean"}
+                   for i in range(8)]
+        de = DistributedEmbedding(configs, world_size=world)
+        local_b = batch // max(world, 1)
+        cap = local_b * 4
+        cats = [Ragged(values=jax.ShapeDtypeStruct((world * cap,),
+                                                   jnp.int32),
+                       row_splits=jax.ShapeDtypeStruct(
+                           (world * (local_b + 1),), jnp.int32))
+                for _ in configs]
+    elif name == "row_sliced":
+        configs = [
+            {"input_dim": 100, "output_dim": 8, "combiner": None},
+            {"input_dim": 30, "output_dim": 8, "combiner": "sum"},
+            {"input_dim": 100, "output_dim": 8, "combiner": "mean"},
+            {"input_dim": 40, "output_dim": 8, "combiner": None},
+            {"input_dim": 26, "output_dim": 8, "combiner": "sum"},
+            {"input_dim": 100, "output_dim": 4, "combiner": "sum"},
+            {"input_dim": 22, "output_dim": 8, "combiner": None},
+            {"input_dim": 24, "output_dim": 8, "combiner": None},
+        ]
+        # the 100-row tables split into 4 row-range slices
+        de = DistributedEmbedding(configs, world_size=world,
+                                  row_slice=100 * 8 // 4 + 1)
+        cats = []
+        for cfg in configs:
+            hot = 1 if cfg["combiner"] is None else 3
+            shape = (batch,) if hot == 1 else (batch, hot)
+            cats.append(jax.ShapeDtypeStruct(shape, jnp.int32))
+    else:
+        raise ValueError(f"unknown config {name!r}")
+
+    cols = sum(int(c["output_dim"]) for c in configs)
+    dense_params = {"w": jax.ShapeDtypeStruct((cols, 1), jnp.float32),
+                    "v": jax.ShapeDtypeStruct((3, 1), jnp.float32)}
+    batch_tree = (jax.ShapeDtypeStruct((batch, 3), jnp.float32),
+                  jax.ShapeDtypeStruct((batch, 1), jnp.float32))
+    return de, cats, batch_tree, dense_params, loss_fn
+
+
+def audit_case(name: str, world: int, batch: int, with_metrics: bool):
+    import jax
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh
+
+    from distributed_embeddings_tpu.analysis import audit_train_step
+    from distributed_embeddings_tpu.parallel import SparseAdagrad
+
+    de, cats, batch_tree, dense_params, loss_fn = build_case(
+        name, world, batch)
+    mesh = None
+    if world > 1:
+        devs = jax.devices()  # backend-ok: JAX_PLATFORMS=cpu forced above
+        if len(devs) < world:
+            raise RuntimeError(
+                f"host platform exposes {len(devs)} devices < {world}")
+        mesh = Mesh(np.array(devs[:world]), ("data",))
+    return audit_train_step(
+        de, loss_fn, optax.sgd(0.5), SparseAdagrad(), cats, batch_tree,
+        mesh=mesh, lr_schedule=0.3, with_metrics=with_metrics,
+        dense_params=dense_params, label=f"{name}/world{world}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--config", choices=("dense", "ragged", "row_sliced",
+                                         "all"), default="all")
+    ap.add_argument("--world", type=int, default=8,
+                    help="mesh positions (CPU virtual devices; default 8)")
+    ap.add_argument("--batch", type=int, default=16, help="global batch")
+    ap.add_argument("--with-metrics", action="store_true",
+                    help="audit the instrumented (DETPU_OBS) step variant")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on any violation (the make verify gate)")
+    ap.add_argument("--json", metavar="PATH",
+                    help="dump the full reports as JSON (- for stdout)")
+    args = ap.parse_args(argv)
+
+    _force_cpu(max(args.world, 1))
+    sys.path.insert(0, REPO)
+
+    names = (["dense", "ragged", "row_sliced"] if args.config == "all"
+             else [args.config])
+    reports = []
+    failed = 0
+    for name in names:
+        try:
+            rep = audit_case(name, args.world, args.batch,
+                             args.with_metrics)
+        except Exception as e:  # noqa: BLE001 - report, then fail the gate
+            print(f"audit_step: {name}: audit errored: {e}",
+                  file=sys.stderr)
+            return 2
+        reports.append(rep)
+        census = rep.a2a_census()
+        status = "OK" if rep.ok else "FAIL"
+        print(f"audit_step: {rep.label}: {status} a2a={census} "
+              f"psum={rep.collective_counts.get('psum', 0)} "
+              f"donated={rep.donation.get('donated')}/"
+              f"{rep.donation.get('expected')}")
+        for v in rep.violations:
+            print(f"audit_step:   violation: {v}", file=sys.stderr)
+            failed += 1
+    if args.json:
+        payload = json.dumps([r.to_json() for r in reports], indent=2)
+        if args.json == "-":
+            print(payload)
+        else:
+            with open(args.json, "w", encoding="utf-8") as fh:
+                fh.write(payload + "\n")
+    if failed and args.strict:
+        print(f"audit_step: {failed} violation(s)", file=sys.stderr)
+        return 1
+    if not failed:
+        print(f"audit_step: OK ({len(reports)} configuration(s) hold the "
+              "SPMD communication contract)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,101 +1,51 @@
 #!/usr/bin/env python
-"""Static gate: no eager jax backend touch in the driver entry points.
+"""Thin shim: the no-eager-backend gate now lives in detlint.
 
-Round 5's artifacts died rc=124 because ``__graft_entry__.py`` called
-``jax.device_count()`` in the parent process before deciding anything —
-a >2 min hang when the TPU tunnel stalls (VERDICT r5). The entry points
-were rewired to decide purely from ``utils.runtime.probe_backend`` (a
-watched subprocess with a timeout); this check keeps the bare calls from
-creeping back in.
+The original AST walker moved verbatim into
+``tools/detlint/rules/eager_backend.py`` (one rule of the unified lint
+framework, run by ``make lint`` / ``python -m tools.detlint``). This shim
+keeps the historical ``make verify`` entry point green while callers
+migrate: it runs exactly that one rule and reports in the old format.
 
-Rules, per checked file (``__graft_entry__.py``, ``bench.py``, and — since
-the observability PR routed them through ``probe_backend`` — every
-``tools/*.py``):
-
-* a backend-touching call (``jax.devices``, ``jax.device_count``,
-  ``jax.local_devices``, ``jax.local_device_count``,
-  ``jax.default_backend``) at MODULE scope (incl. the ``__main__`` block)
-  always fails — it runs before any probe can;
-* inside a function it must carry a ``# backend-ok: <reason>`` annotation
-  on the same line, asserting the call only executes in a probe-cleared
-  context (e.g. the dryrun child process).
-
-Runs from ``make verify``. No jax import needed — pure AST.
+Rules (see the rule module's docstring): backend-touching jax calls
+(``jax.devices``/``device_count``/...) at module scope always fail;
+inside a function they need a same-line ``# backend-ok: <reason>``
+annotation. No jax import needed — pure AST.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-BACKEND_ATTRS = {"devices", "device_count", "local_devices",
-                 "local_device_count", "default_backend"}
-MARKER = "backend-ok:"
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-CHECKED_FILES = ("__graft_entry__.py", "bench.py")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools import detlint  # noqa: E402
 
 
-def _tool_files():
-    """Every ``tools/*.py`` (this checker included — it holds itself to
-    its own rule; trivially, since it never imports jax)."""
-    d = os.path.join(REPO, "tools")
-    return tuple(os.path.join("tools", name) for name in sorted(
-        os.listdir(d)) if name.endswith(".py"))
-
-
-def _is_backend_call(node: ast.Call) -> bool:
-    f = node.func
-    return (isinstance(f, ast.Attribute) and f.attr in BACKEND_ATTRS
-            and isinstance(f.value, ast.Name) and f.value.id == "jax")
-
-
-def check_file(path: str) -> list:
-    with open(path, encoding="utf-8") as fh:
-        src = fh.read()
-    lines = src.splitlines()
-    errors = []
-
-    def walk(node, in_function):
-        for child in ast.iter_child_nodes(node):
-            child_in_fn = in_function or isinstance(
-                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda))
-            if isinstance(child, ast.Call) and _is_backend_call(child):
-                where = f"{os.path.relpath(path, REPO)}:{child.lineno}"
-                line = lines[child.lineno - 1]
-                if not in_function:
-                    errors.append(
-                        f"{where}: module-scope jax.{child.func.attr}() — "
-                        "runs before any backend probe and hangs the "
-                        "process on a stalled tunnel; route through "
-                        "utils.runtime.probe_backend/require_devices")
-                elif MARKER not in line:
-                    errors.append(
-                        f"{where}: jax.{child.func.attr}() without a "
-                        f"'# {MARKER} <reason>' annotation — either probe "
-                        "first (utils.runtime) or annotate why this only "
-                        "executes in a probe-cleared context")
-            walk(child, child_in_fn)
-
-    walk(ast.parse(src, path), False)
-    return errors
+# the rule walks whatever exists; the gate additionally pins that the two
+# historical entry points are PRESENT — a renamed __graft_entry__.py (the
+# r5 rc=124 file) must not make the protection vanish vacuously
+REQUIRED_FILES = ("__graft_entry__.py", "bench.py")
 
 
 def main() -> int:
-    errors = []
-    checked = CHECKED_FILES + _tool_files()
-    for name in checked:
-        path = os.path.join(REPO, name)
-        if not os.path.exists(path):
-            errors.append(f"{name}: checked file missing")
-            continue
-        errors.extend(check_file(path))
-    for e in errors:
-        print(f"check_no_eager_backend: {e}", file=sys.stderr)
-    if not errors:
-        print(f"check_no_eager_backend: OK ({len(checked)} files clean: "
-              f"{', '.join(CHECKED_FILES)} + tools/*.py)")
-    return 1 if errors else 0
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    missing = [name for name in REQUIRED_FILES
+               if not os.path.exists(os.path.join(repo, name))]
+    for name in missing:
+        print(f"check_no_eager_backend: {name}: checked file missing",
+              file=sys.stderr)
+    if missing:
+        return 1
+    findings = detlint.run(rule_names=["eager-backend"])
+    for f in findings:
+        print(f"check_no_eager_backend: {f.path}:{f.line}: {f.message}",
+              file=sys.stderr)
+    if not findings:
+        print("check_no_eager_backend: OK (detlint rule 'eager-backend' "
+              "clean over __graft_entry__.py, bench.py, tools/**)")
+    return 1 if findings else 0
 
 
 if __name__ == "__main__":

@@ -32,25 +32,15 @@ def check_import() -> list:
     """obs must import (and count) cleanly under ``JAX_PLATFORMS=cpu`` in
     a fresh process, and the module source must not import jax at module
     scope (the :mod:`utils.runtime` never-touch-a-backend-at-import
-    contract; the *package* path unavoidably imports jax via compat, so
-    the module-scope property is checked statically)."""
-    import ast
+    contract; the *package* path unavoidably imports jax via compat). The
+    static half is the detlint ``module-scope-jax`` rule — shared here so
+    the AST walking lives in exactly one place."""
+    sys.path.insert(0, REPO)
+    from tools import detlint
 
-    errors = []
-    obs_path = os.path.join(REPO, "distributed_embeddings_tpu", "utils",
-                            "obs.py")
-    tree = ast.parse(open(obs_path, encoding="utf-8").read(), obs_path)
-    for node in ast.iter_child_nodes(tree):
-        names = []
-        if isinstance(node, ast.Import):
-            names = [a.name for a in node.names]
-        elif isinstance(node, ast.ImportFrom) and node.level == 0:
-            names = [node.module or ""]
-        if any(n == "jax" or n.startswith("jax.") for n in names):
-            errors.append(f"obs.py:{node.lineno}: module-scope jax import "
-                          "— obs must stay importable without jax (the "
-                          "runtime-layer contract); import it inside the "
-                          "function that needs it")
+    errors = [
+        f"{f.path}:{f.line}: {f.message}"
+        for f in detlint.run(rule_names=["module-scope-jax"])]
     code = (
         "import distributed_embeddings_tpu.utils.obs as obs\n"
         "obs.counter_inc('selftest'); assert obs.counters()['selftest'] == 1\n"

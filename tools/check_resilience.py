@@ -29,6 +29,10 @@ import sys
 import tempfile
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# the children template their own sys.path insert; main() imports the
+# package too (PREEMPT_EXIT_CODE), so running as `python tools/...` from
+# anywhere must work without a PYTHONPATH
+sys.path.insert(0, REPO)
 
 STEPS = 12
 SIGTERM_AFTER_STEP = 3  # parent fires once the child reports this step

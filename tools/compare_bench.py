@@ -120,8 +120,27 @@ def check_env(old: Dict[str, Any], new: Dict[str, Any]) -> None:
                   "apples-to-apples", file=sys.stderr)
 
 
+def check_steady_state(new: Dict[str, Any]) -> int:
+    """The recompile gate: a candidate record carrying the PR 4
+    ``steady_state_recompiles`` field (compiles observed inside bench.py's
+    TIMED loops, warmup excluded; obs compile-listener counter) must show
+    zero — a nonzero count means some section retraces per step, which
+    poisons every throughput number in the same record. Absolute property
+    of the NEW record, no baseline needed; absent field (pre-PR-4 records,
+    runs without DETPU_OBS) passes."""
+    n = new.get("steady_state_recompiles")
+    if isinstance(n, (int, float)) and n > 0:
+        print(f"compare_bench: steady_state_recompiles={int(n)} — the "
+              "candidate bench retraced inside a timed loop; its "
+              "throughput numbers measure compiles, not steps",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def compare(old: Dict[str, Any], new: Dict[str, Any],
             threshold: float) -> int:
+    steady_failures = check_steady_state(new)
     regressions = 0
     rows = []
     for keys, higher_better in ((THROUGHPUT_KEYS, True), (MS_KEYS, False)):
@@ -151,9 +170,10 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
     if regressions:
         print(f"compare_bench: {regressions} metric(s) regressed beyond "
               f"{threshold * 100:.0f}%", file=sys.stderr)
+    if regressions or steady_failures:
         return 1
     print(f"compare_bench: OK ({len(rows)} metric(s) compared, none beyond "
-          f"{threshold * 100:.0f}%)")
+          f"{threshold * 100:.0f}%; steady-state recompiles clean)")
     return 0
 
 

@@ -1,0 +1,4 @@
+"""detlint rule modules. A rule is any module here exposing ``NAME``,
+``SCOPE`` (glob patterns), optional ``EXCLUDE``, and
+``check(tree, path, src, ctx) -> [Finding]`` — discovery is automatic
+(``tools.detlint.discover_rules`` walks this package)."""

@@ -1,0 +1,29 @@
+"""No bare ``except:`` anywhere in the repo.
+
+A bare except swallows ``KeyboardInterrupt`` and ``SystemExit`` — in this
+codebase that means a preemption SIGTERM-turned-exit or a deadline
+``SIGALRM`` escalation can be silently eaten by an over-broad handler,
+exactly the failure the resilient driver exists to surface. Catch
+``Exception`` (and say why) instead.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import Finding
+
+NAME = "bare-except"
+SCOPE = ("**",)
+
+
+def check(tree: ast.Module, path: str, src: str, ctx) -> list:
+    findings = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            findings.append(Finding(
+                NAME, path, node.lineno,
+                "bare 'except:' swallows KeyboardInterrupt/SystemExit "
+                "(preemption + deadline escalation paths); catch "
+                "'Exception' at most, and name why"))
+    return findings

@@ -1,0 +1,47 @@
+"""No host fetches (``jax.device_get`` / ``.item()``) in ``parallel/``.
+
+The modules under ``distributed_embeddings_tpu/parallel/`` hold the code
+that runs inside (or builds) the jitted SPMD step; a ``.item()`` or
+``jax.device_get`` there is a device->host sync — under jit it inserts a
+callback-shaped stall, and in builder code it blocks the dispatch
+pipeline. Host-side driver code that legitimately reads back (the
+resilient driver's loss escalation) uses ``float(np.asarray(...))`` at
+clearly-host points; anything that truly needs the fetch can annotate the
+line with ``# host-ok: <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import Finding
+
+NAME = "host-fetch"
+SCOPE = ("distributed_embeddings_tpu/parallel/*.py",)
+MARKER = "host-ok:"
+
+
+def check(tree: ast.Module, path: str, src: str, ctx) -> list:
+    lines = src.splitlines()
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        what = None
+        if isinstance(f, ast.Attribute) and f.attr == "item" \
+                and not node.args and not node.keywords:
+            what = ".item()"
+        elif (isinstance(f, ast.Attribute) and f.attr == "device_get"
+                and isinstance(f.value, ast.Name) and f.value.id == "jax"):
+            what = "jax.device_get()"
+        if what is None:
+            continue
+        if MARKER in lines[node.lineno - 1]:
+            continue
+        findings.append(Finding(
+            NAME, path, node.lineno,
+            f"{what} in parallel/ — a device->host sync in step/builder "
+            "code; keep readbacks in the host driver "
+            f"(or annotate '# {MARKER} <reason>')"))
+    return findings

@@ -1,0 +1,72 @@
+"""Every collective exchange site runs under an ``obs.scope`` phase.
+
+The step auditor (``analysis/audit.py``) attributes each collective to the
+``jax.named_scope`` phase it was traced under — that is how an audit
+report can say *which* exchange broke the census, and how an XLA profile
+attributes device time to phases. A ``lax.all_to_all`` added outside a
+``with obs.scope(...)`` block would audit as an "unscoped" collective and
+profile as anonymous time; this rule makes the omission a lint error at
+review time. Annotate ``# scope-ok: <reason>`` for a site that genuinely
+cannot take a scope.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import Finding
+
+NAME = "named-scope-exchange"
+SCOPE = ("distributed_embeddings_tpu/**",)
+MARKER = "scope-ok:"
+
+EXCHANGE_ATTRS = {"all_to_all", "all_gather", "reduce_scatter",
+                  "ppermute"}
+
+
+def _is_exchange_call(node: ast.Call) -> bool:
+    f = node.func
+    if not isinstance(f, ast.Attribute) or f.attr not in EXCHANGE_ATTRS:
+        return False
+    v = f.value
+    # lax.all_to_all(...) / jax.lax.all_to_all(...)
+    if isinstance(v, ast.Name) and v.id == "lax":
+        return True
+    return (isinstance(v, ast.Attribute) and v.attr == "lax"
+            and isinstance(v.value, ast.Name) and v.value.id == "jax")
+
+
+def _is_scope_with(node: ast.With) -> bool:
+    for item in node.items:
+        call = item.context_expr
+        if not isinstance(call, ast.Call):
+            continue
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr == "scope":
+            return True
+        if isinstance(f, ast.Name) and f.id == "scope":
+            return True
+    return False
+
+
+def check(tree: ast.Module, path: str, src: str, ctx) -> list:
+    lines = src.splitlines()
+    findings = []
+
+    def walk(node, scoped):
+        for child in ast.iter_child_nodes(node):
+            child_scoped = scoped or (isinstance(child, ast.With)
+                                      and _is_scope_with(child))
+            if (isinstance(child, ast.Call) and _is_exchange_call(child)
+                    and not scoped
+                    and MARKER not in lines[child.lineno - 1]):
+                findings.append(Finding(
+                    NAME, path, child.lineno,
+                    f"{child.func.attr} outside a 'with obs.scope(...)' "
+                    "block — the step auditor and XLA profiles cannot "
+                    "attribute this exchange to a phase (or annotate "
+                    f"'# {MARKER} <reason>')"))
+            walk(child, child_scoped)
+
+    walk(tree, False)
+    return findings
