@@ -4,7 +4,8 @@
 SHELL := /bin/bash
 
 .PHONY: all native test test-fast bench bench-diff clean pkg verify \
-        lint audit-step check-backend check-obs check-resilience
+        lint audit-step check-backend check-obs check-obs-report \
+        check-resilience obs-report
 
 all: native
 
@@ -26,7 +27,8 @@ bench:
 # plus the static gates (detlint rules, the SPMD step auditor, the legacy
 # no-eager-backend shim), the observability gate, and the
 # preemption-recovery drill — run before shipping a round
-verify: lint audit-step check-backend check-obs check-resilience
+verify: lint audit-step check-backend check-obs check-obs-report \
+        check-resilience
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -55,6 +57,19 @@ check-backend:
 # the DETPU_OBS=1 smoke bench emits a parseable step-metrics sidecar
 check-obs:
 	python tools/check_obs.py
+
+# observatory render gate: synthetic metrics JSONL + telemetry summary
+# through the full fusion/render path (no jax, sub-second)
+check-obs-report:
+	python tools/obs_report.py --selftest
+
+# the embedding telemetry observatory (acceptance run): 8-virtual-device
+# CPU mesh, Zipfian inputs with planted heavy hitters + engineered rank
+# skew; fails unless the top-k recovers the plants, the skew shows in the
+# per-rank ratios, and the telemetry is jit-carried (0 steady-state
+# recompiles, no host callbacks in the audited jaxpr)
+obs-report:
+	env JAX_PLATFORMS=cpu python tools/obs_report.py
 
 # preemption drill: SIGTERM a child resilient driver mid-run, resume it,
 # and require the final state to match an uninterrupted run bit for bit

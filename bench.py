@@ -269,7 +269,8 @@ def run_dlrm(table_sizes, compute_dtype, param_dtype=jnp.float32,
         # one instrumented step with a profile capture; the donated state
         # it returns seeds the timed loop below
         mstep = make_hybrid_train_step(de, loss_fn, tx, emb_opt,
-                                       lr_schedule=0.005, with_metrics=True)
+                                       lr_schedule=0.005, with_metrics=True,
+                                       telemetry=False)
         with obs.profile_trace(f"bench_{metrics_variant}"):
             _, state, metrics = mstep(state, cats1, (num, labels))
         _METRICS_LOGGER.log_step(metrics, variant=metrics_variant,
@@ -279,12 +280,12 @@ def run_dlrm(table_sizes, compute_dtype, param_dtype=jnp.float32,
         step_fn = make_hybrid_train_step(de, loss_fn, tx, emb_opt,
                                          lr_schedule=0.005,
                                          with_metrics=False,
-                                         nan_guard=False)
+                                         nan_guard=False, telemetry=False)
         dt = timed_loop(step_fn, state, (cats1, (num, labels)))
         return batch / dt
     loop_fn = make_hybrid_train_loop(de, loss_fn, tx, emb_opt,
                                      lr_schedule=0.005, with_metrics=False,
-                                     nan_guard=False)
+                                     nan_guard=False, telemetry=False)
     dt = timed_loop(loop_fn, state,
                     (cat_stacks, (num_stack, lab_stack)), iters=4)
     return batch * K / dt
@@ -328,7 +329,7 @@ def run_tiny_zoo(opt_name, steps_per_call=ZOO_STEPS_PER_CALL,
                               jax.random.key(1), dtype=param_dtype)
     loop_fn = make_hybrid_train_loop(de, loss_fn, tx, emb_opt,
                                      lr_schedule=0.01, with_metrics=False,
-                                     nan_guard=False)
+                                     nan_guard=False, telemetry=False)
     dt = timed_loop(loop_fn, state,
                     (cat_stacks, (num_stack, lab_stack)), iters=4)
     return dt / K * 1e3
@@ -614,6 +615,131 @@ def run_resilient_overhead():
     }
 
 
+def run_step_memory():
+    """Static capacity accounting of the headline step (ISSUE 5): the
+    capped bf16 DLRM step is abstractly lowered + compiled for THIS
+    backend and XLA's own memory/cost analysis is read back —
+    per-step peak-HBM estimate, argument/temp bytes, FLOPs — alongside
+    the layout's param/optimizer-state budget. No execution, one extra
+    compile; ``tools/compare_bench.py`` gates ``peak_hbm_mb`` like a
+    throughput metric (>10% growth fails)."""
+    from distributed_embeddings_tpu.analysis import memory as dmem
+
+    table_sizes = [min(s, CAP) for s in CRITEO_KAGGLE_SIZES]
+    cfg = make_cfg(table_sizes, jnp.bfloat16)
+    de = DistributedEmbedding(cfg.embedding_configs(), world_size=1,
+                              compute_dtype=jnp.bfloat16)
+    dense = DLRMDense(cfg)
+
+    def loss_fn(dp, emb_outs, b):
+        n, y = b
+        return bce_with_logits(dense.apply(dp, n, emb_outs), y)
+
+    rng = np.random.default_rng(0)
+    num2 = jnp.asarray(rng.normal(size=(2, 13)), jnp.float32)
+    dense_params = dense.init(
+        jax.random.key(0), num2,
+        [jnp.zeros((2, cfg.embedding_dim), jnp.float32)
+         for _ in table_sizes])
+    cats = [jax.ShapeDtypeStruct((BATCH,), jnp.int32)
+            for _ in table_sizes]
+    batch_tree = (jax.ShapeDtypeStruct((BATCH, 13), jnp.float32),
+                  jax.ShapeDtypeStruct((BATCH, 1), jnp.float32))
+    rep = dmem.step_memory_report(
+        de, loss_fn, optax.sgd(0.005), SparseSGD(), cats, batch_tree,
+        dense_params=dense_params, param_dtype=jnp.bfloat16,
+        nan_guard=False)
+    comp = rep["compiled"]
+    totals = rep["layout"]["totals"]
+
+    def mb(x):
+        return None if x is None else round(x / 1e6, 2)
+
+    return {
+        "peak_hbm_mb": mb(comp.get("peak_bytes_est")),
+        "argument_mb": mb(comp.get("argument_bytes")),
+        "temp_mb": mb(comp.get("temp_bytes")),
+        "alias_mb": mb(comp.get("alias_bytes")),
+        "flops": comp.get("flops"),
+        "bytes_accessed_mb": mb(comp.get("bytes_accessed")),
+        "param_mb_allocated": mb(totals["param_bytes_allocated"]),
+        "param_mb_live": mb(totals["param_bytes_live"]),
+        "opt_state_mb": mb(totals["opt_state_bytes"]),
+        "layout_padding_frac": round(totals["padding_frac"], 4),
+        "backend": comp.get("backend"),
+        "error": comp.get("error"),
+    }
+
+
+def run_telemetry_overhead():
+    """Access-telemetry cost (ISSUE 5): the SAME single-chip DLRM step
+    timed with the jit-carried telemetry compiled OUT (the headline
+    program — telemetry defaults off, so headline numbers stay
+    round-comparable) and compiled IN (sketch scatter-adds + top-k merge
+    per step). Both ride the steady-state recompile gate."""
+    from distributed_embeddings_tpu.analysis import telemetry as tel
+
+    table_sizes = [min(s, CAP) for s in CRITEO_KAGGLE_SIZES]
+    batch = BATCH if SMOKE else 16384
+    cfg = make_cfg(table_sizes, jnp.bfloat16)
+    emb_opt = SparseSGD()
+    tx = optax.sgd(0.005)
+    rng = np.random.default_rng(0)
+    cats = [jnp.asarray(power_law_ids(rng, s, (batch,)), jnp.int32)
+            for s in table_sizes]
+
+    def build(telemetry):
+        de = DistributedEmbedding(cfg.embedding_configs(), world_size=1,
+                                  compute_dtype=jnp.bfloat16)
+        dense = DLRMDense(cfg)
+
+        def loss_fn(dp, emb_outs, b):
+            n, y = b
+            return bce_with_logits(dense.apply(dp, n, emb_outs), y)
+
+        state, num, labels = build_state(de, dense, cfg, emb_opt, tx,
+                                         table_sizes, jnp.bfloat16,
+                                         batch=batch)
+        fn = make_hybrid_train_step(de, loss_fn, tx, emb_opt,
+                                    lr_schedule=0.005, with_metrics=False,
+                                    nan_guard=False, telemetry=telemetry)
+        return de, fn, state, num, labels
+
+    global _STEADY_RECOMPILES
+    iters = RESIL_STEPS
+    de, off, state, num, labels = build(False)
+    dt_off = timed_loop(off, state, (cats, (num, labels)), iters=iters,
+                        warmup=2)
+
+    tcfg = tel.config_from_env()
+    de, on, state, num, labels = build(tcfg)
+    telem = tel.init_telemetry(de, tcfg)
+    loss = None
+    for _ in range(2):  # 4-ary signature: timed_loop unpacks 2 — inline
+        loss, state, telem = on(state, cats, (num, labels), telem)
+    _force(loss)
+    compiles0 = _compiles_now()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss, state, telem = on(state, cats, (num, labels), telem)
+    _force(loss)
+    dt_on = (time.perf_counter() - t0) / iters
+    # a carried state that retraced per step would poison this section's
+    # numbers — same gate as every timed loop
+    _STEADY_RECOMPILES += _compiles_now() - compiles0
+
+    return {
+        "telemetry_off_samples_per_sec": round(batch / dt_off, 1),
+        "telemetry_samples_per_sec": round(batch / dt_on, 1),
+        # conventional overhead reading: extra time per step relative to
+        # the telemetry-off step (2x step time -> 1.0, not 0.5)
+        "telemetry_overhead_frac": round(dt_on / dt_off - 1.0, 4),
+        "sketch": dict(tcfg._asdict()),
+        "batch": batch,
+        "steps": iters,
+    }
+
+
 CONV_STEPS = 6 if SMOKE else 360
 CONV_BATCH = 512 if SMOKE else 8192
 
@@ -864,6 +990,18 @@ def main():
         if proj:
             # >= 1.0 means the input side cannot cap the v5e-16 projection
             out["input_pipeline_vs_projection"] = round(rate / proj, 3)
+    stepmem = _guard("step_memory", run_step_memory)
+    if stepmem is not None:
+        out["step_memory"] = stepmem
+        if stepmem.get("peak_hbm_mb") is not None:
+            # lifted so compare_bench gates per-step peak HBM growth
+            # (>10% fails) like any other headline metric
+            out["peak_hbm_mb"] = stepmem["peak_hbm_mb"]
+    telov = _guard("telemetry_overhead", run_telemetry_overhead)
+    if telov is not None:
+        out["telemetry_overhead"] = telov
+        out["telemetry_samples_per_sec"] = telov[
+            "telemetry_samples_per_sec"]
     resil = _guard("resilient_overhead", run_resilient_overhead)
     if resil is not None:
         # nested record for the bench report; the two samples/s terms are

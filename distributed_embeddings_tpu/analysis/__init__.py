@@ -1,10 +1,16 @@
-"""Static analysis of compiled SPMD train steps.
+"""Static analysis + telemetry of compiled SPMD train steps.
 
 The hybrid-parallel design is a *communication contract* — exactly one id
 all-to-all and one output all-to-all forward, one cotangent all-to-all
 backward — and this package verifies it by abstract interpretation
 (jaxpr/StableHLO inspection, no backend execution) instead of by reading
 throughput numbers after the fact. See :mod:`.audit`.
+
+Two sibling layers complete the observatory: :mod:`.telemetry` (on-device
+jit-carried access telemetry — per-table hot-row sketches, per-rank load
+accounting) and :mod:`.memory` (static per-table/slab HBM budgets plus
+compiled-step memory/FLOP reports via abstract lowering). Fused into one
+run report by ``tools/obs_report.py``.
 """
 
 from .audit import (
@@ -15,6 +21,19 @@ from .audit import (
     audit_train_step,
     expected_collectives,
 )
+from .memory import (
+    compiled_step_report,
+    step_memory_report,
+    table_memory_report,
+)
+from .telemetry import (
+    TelemetryConfig,
+    hot_rows,
+    init_telemetry,
+    load_balance,
+    summarize_telemetry,
+    telemetry_enabled,
+)
 
 __all__ = [
     "AuditError",
@@ -23,4 +42,13 @@ __all__ = [
     "audit_step_fn",
     "audit_train_step",
     "expected_collectives",
+    "TelemetryConfig",
+    "init_telemetry",
+    "hot_rows",
+    "load_balance",
+    "summarize_telemetry",
+    "telemetry_enabled",
+    "table_memory_report",
+    "compiled_step_report",
+    "step_memory_report",
 ]

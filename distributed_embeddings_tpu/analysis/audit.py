@@ -454,12 +454,22 @@ def audit_train_step(de: DistributedEmbedding,
                      lr_schedule=1.0,
                      with_metrics: Optional[bool] = None,
                      nan_guard: Optional[bool] = None,
+                     telemetry=None,
                      dense_params=None,
                      state=None,
                      expected: Optional[Dict[str, Any]] = None,
                      label: str = "hybrid_train_step") -> AuditReport:
     """Build the hybrid train step exactly like
     :func:`~..parallel.trainer.make_hybrid_train_step` and audit it.
+
+    ``telemetry`` follows the step builder's contract (explicit opt-in:
+    ``True``/config = on): the telemetry-instrumented variant is audited
+    with an abstract carried state as the fourth argument, and the SAME
+    communication contract — access telemetry is rank-local by design
+    (sketch scatter-adds + top-k merges, no collectives, no host
+    interop), so a telemetry build that changes the census is a bug this
+    audit catches. The donation audit grows by the telemetry leaves
+    (the carried state is donated like the train state).
 
     Args mirror the step builder; additionally:
 
@@ -482,11 +492,13 @@ def audit_train_step(de: DistributedEmbedding,
       for strict use.
     """
     from ..utils import obs
+    from . import telemetry as tel
 
     if with_metrics is None:
         with_metrics = obs.metrics_enabled()
     if nan_guard is None:
         nan_guard = obs.nanguard_enabled()
+    tel_cfg = tel.resolve_config(telemetry)
 
     if state is None:
         if dense_params is None:
@@ -502,7 +514,7 @@ def audit_train_step(de: DistributedEmbedding,
     step = trainer_mod.make_hybrid_train_step(
         de, loss_fn, dense_tx, emb_optimizer, mesh=mesh,
         lr_schedule=lr_schedule, with_metrics=with_metrics,
-        nan_guard=nan_guard)
+        nan_guard=nan_guard, telemetry=tel_cfg if tel_cfg else False)
 
     if expected is None:
         expected = expected_collectives(
@@ -510,10 +522,17 @@ def audit_train_step(de: DistributedEmbedding,
             n_dense_leaves=len(jax.tree_util.tree_leaves(
                 state.dense_params)))
 
+    args = (state, cat_inputs, batch)
+    donated = len(jax.tree_util.tree_leaves(state))
+    if tel_cfg is not None:
+        telem = jax.eval_shape(lambda: tel.init_telemetry(de, tel_cfg))
+        args = args + (telem,)
+        donated += len(jax.tree_util.tree_leaves(telem))
+
     report, out_shape = _audit_step_fn(
-        step, (state, cat_inputs, batch),
+        step, args,
         world=de.world_size, dp_input=de.dp_input, expected=expected,
-        expected_donated=len(jax.tree_util.tree_leaves(state)),
+        expected_donated=donated,
         label=label)
 
     # embedding-table dtype must be preserved end-to-end: state out is

@@ -1,0 +1,305 @@
+"""Static capacity accounting: per-table/slab HBM budgets and
+compiled-step memory/FLOP reports.
+
+The paper's sharding exists because embedding tables dominate HBM — yet
+nothing in the repo could answer "how many bytes does table 17 actually
+cost on its rank, optimizer state included, layout padding included?"
+or "what does the compiled step peak at?" without running on a chip and
+eyeballing allocator logs. This module answers both *abstractly*:
+
+* :func:`table_memory_report` prices every global table and every width
+  slab from the strategy alone — parameter bytes, optimizer-state bytes
+  (``jax.eval_shape`` over the sparse optimizer's ``init``, so any
+  optimizer prices itself), lane/row padding overhead, per-rank live
+  bytes. Pure metadata; no arrays are materialized.
+* :func:`compiled_step_report` lowers + compiles a jitted step (CPU-safe
+  — compilation never executes anything) and reads XLA's own
+  ``memory_analysis()`` / ``cost_analysis()``: argument/output/temp/
+  alias bytes and FLOPs. Probe-guarded like :func:`~.audit.
+  audit_train_step`: backends that expose no analysis yield a report
+  with an ``error`` field, never an exception.
+* :func:`step_memory_report` fuses the two around a hybrid train step
+  built exactly like :func:`~..parallel.trainer.make_hybrid_train_step`
+  builds it, plus rough per-table per-step HBM/FLOP estimates derived
+  from the input encodings (gather + scatter-update traffic).
+
+Run under ``JAX_PLATFORMS=cpu`` with
+``--xla_force_host_platform_device_count=N`` for an N-position mesh —
+the same harness as the step auditor; ``tools/obs_report.py`` does it
+for the reference configs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel import trainer as trainer_mod
+
+
+def _itemsize(dtype) -> int:
+    return jnp.dtype(dtype).itemsize
+
+
+def _leaf_bytes(tree) -> int:
+    """Total bytes of a ShapeDtypeStruct/array pytree."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        total += int(np.prod(shape, dtype=np.int64)) * _itemsize(dtype)
+    return total
+
+
+def table_memory_report(de, emb_optimizer=None,
+                        param_dtype=jnp.float32) -> Dict[str, Any]:
+    """Price ``de``'s layout without materializing anything.
+
+    Returns ``{"tables": [...], "slabs": {...}, "per_rank": [...],
+    "totals": {...}}``:
+
+    * ``tables[tid]`` — rows, width, logical parameter bytes, slice
+      count, whether row-sliced, owning ranks;
+    * ``slabs[wN]`` — the physical ``[world, phys_cap, phys_w]`` stacked
+      slab: allocated vs live bytes (the difference is lane packing +
+      row alignment + rank-imbalance padding), optimizer-state bytes
+      for the slab (from ``eval_shape(emb_optimizer.init)``);
+    * ``per_rank[r]`` — live parameter bytes and table count actually
+      placed on rank ``r`` (the placement-imbalance view);
+    * ``totals`` — params allocated/live, optimizer state, padding
+      fraction.
+    """
+    isz = _itemsize(param_dtype)
+    world = de.world_size
+
+    tables: List[Dict[str, Any]] = []
+    for tid, cfg in enumerate(de.strategy.global_configs):
+        rows, width = int(cfg["input_dim"]), int(cfg["output_dim"])
+        ranks = [r for r, ids in enumerate(de.strategy.table_ids_list)
+                 if tid in ids]
+        tables.append({
+            "table_id": tid,
+            "rows": rows,
+            "width": width,
+            "param_bytes": rows * width * isz,
+            "slices": int(de._slices_per_table[tid]),
+            "row_sliced": tid in de.strategy.row_sliced_tables,
+            "ranks": ranks,
+        })
+
+    # abstract global params — exactly what de.init would build
+    abs_params = {
+        f"w{w}": jax.ShapeDtypeStruct(
+            (world, de.phys_cap[w], de.phys_w[w]), param_dtype)
+        for w in de.widths}
+    opt_bytes_by_width: Dict[str, int] = {}
+    opt_error = None
+    if emb_optimizer is not None:
+        try:
+            abs_state = jax.eval_shape(emb_optimizer.init, abs_params)
+            if isinstance(abs_state, dict):
+                for k in abs_params:
+                    opt_bytes_by_width[k] = _leaf_bytes(abs_state.get(k))
+            else:  # non-dict state: price it once under the first width
+                opt_bytes_by_width[next(iter(abs_params))] = \
+                    _leaf_bytes(abs_state)
+        except Exception as e:  # noqa: BLE001 - accounting must not throw
+            opt_error = f"{type(e).__name__}: {e}"
+
+    slabs: Dict[str, Any] = {}
+    live_by_rank = [0] * world
+    tables_by_rank = [0] * world
+    for r, cfgs in enumerate(de.strategy.local_configs_list):
+        tables_by_rank[r] = len(cfgs)
+        for cfg in cfgs:
+            live_by_rank[r] += (int(cfg["input_dim"])
+                                * int(cfg["output_dim"]) * isz)
+    for w in de.widths:
+        key = f"w{w}"
+        shape = (world, de.phys_cap[w], de.phys_w[w])
+        alloc = int(np.prod(shape, dtype=np.int64)) * isz
+        live = sum(int(cfg["input_dim"]) * w * isz
+                   for cfgs in de.strategy.local_configs_list
+                   for cfg in cfgs if int(cfg["output_dim"]) == w)
+        slabs[key] = {
+            "shape": list(shape),
+            "param_bytes": alloc,
+            "live_bytes": live,
+            "padding_bytes": alloc - live,
+            "opt_state_bytes": opt_bytes_by_width.get(key),
+        }
+
+    alloc_total = sum(s["param_bytes"] for s in slabs.values())
+    live_total = sum(s["live_bytes"] for s in slabs.values())
+    opt_total = (sum(v for v in opt_bytes_by_width.values())
+                 if opt_bytes_by_width else None)
+    return {
+        "world": world,
+        "param_dtype": str(jnp.dtype(param_dtype)),
+        "tables": tables,
+        "slabs": slabs,
+        "per_rank": [{"rank": r, "live_param_bytes": live_by_rank[r],
+                      "tables": tables_by_rank[r]}
+                     for r in range(world)],
+        "totals": {
+            "param_bytes_allocated": alloc_total,
+            "param_bytes_live": live_total,
+            "padding_frac": ((alloc_total - live_total) / alloc_total
+                             if alloc_total else 0.0),
+            "opt_state_bytes": opt_total,
+            "opt_state_error": opt_error,
+        },
+    }
+
+
+def compiled_step_report(step_fn, args) -> Dict[str, Any]:
+    """XLA's own memory/cost view of a jitted callable, by abstract
+    lowering + compilation (nothing executes; safe on CPU and on any
+    backend whose compiler is reachable).
+
+    ``args`` may be concrete arrays or ``jax.ShapeDtypeStruct`` pytrees.
+    Missing analyses (backend-dependent) leave their fields ``None``
+    with the reason in ``error`` — a report, never an exception.
+    """
+    out: Dict[str, Any] = {
+        "argument_bytes": None, "output_bytes": None, "temp_bytes": None,
+        "alias_bytes": None, "generated_code_bytes": None,
+        "peak_bytes_est": None, "flops": None, "bytes_accessed": None,
+        "backend": None, "error": None,
+    }
+    if not hasattr(step_fn, "lower"):
+        out["error"] = "step_fn has no .lower() — pass the jit wrapper"
+        return out
+    try:
+        compiled = step_fn.lower(*args).compile()
+    except Exception as e:  # noqa: BLE001 - probe-guarded by contract
+        out["error"] = f"lower/compile failed: {type(e).__name__}: {e}"
+        return out
+    try:
+        out["backend"] = jax.default_backend()
+    except Exception:  # noqa: BLE001 - stamp is best-effort
+        pass
+    try:
+        mem = compiled.memory_analysis()
+    except Exception as e:  # noqa: BLE001 - analysis is backend-optional
+        mem, out["error"] = None, f"memory_analysis: {e}"
+    if mem is not None:
+        arg = int(getattr(mem, "argument_size_in_bytes", 0))
+        outb = int(getattr(mem, "output_size_in_bytes", 0))
+        tmp = int(getattr(mem, "temp_size_in_bytes", 0))
+        ali = int(getattr(mem, "alias_size_in_bytes", 0))
+        out.update(
+            argument_bytes=arg, output_bytes=outb, temp_bytes=tmp,
+            alias_bytes=ali,
+            generated_code_bytes=int(
+                getattr(mem, "generated_code_size_in_bytes", 0)),
+            # donated (aliased) buffers are counted once: they are the
+            # same HBM on the way in and out
+            peak_bytes_est=arg + outb + tmp - ali)
+    try:
+        cost = compiled.cost_analysis()
+    except Exception as e:  # noqa: BLE001 - analysis is backend-optional
+        cost = None
+        out["error"] = (out["error"] or "") + f" cost_analysis: {e}"
+    if cost:
+        # some jax versions return [dict], others dict
+        c = cost[0] if isinstance(cost, (list, tuple)) else cost
+        if isinstance(c, dict):
+            if c.get("flops") is not None:
+                out["flops"] = float(c["flops"])
+            if c.get("bytes accessed") is not None:
+                out["bytes_accessed"] = float(c["bytes accessed"])
+    return out
+
+
+def _input_traffic_estimates(de, cat_inputs,
+                             param_dtype) -> List[Dict[str, Any]]:
+    """Rough per-table per-step HBM/FLOP estimates from the input
+    shapes: each live id costs one row gather forward plus a
+    read-modify-write scatter update backward (~3 row passes), and
+    ~4 flops per gathered element (combine + backward accumulate).
+    Upper bounds for ragged inputs (priced at static capacity)."""
+    from ..ops.embedding_lookup import Ragged
+
+    isz = _itemsize(param_dtype)
+    est: Dict[int, Dict[str, float]] = {}
+    for i, inp in enumerate(cat_inputs):
+        tid = de.strategy.input_table_map[i]
+        if isinstance(inp, Ragged):
+            ids = int(np.shape(inp.values)[0])  # static capacity
+        else:
+            shape = tuple(getattr(inp, "shape", ()))
+            ids = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        e = est.setdefault(tid, {"ids_per_step": 0.0})
+        e["ids_per_step"] += ids
+    out = []
+    for tid in sorted(est):
+        ids = est[tid]["ids_per_step"]
+        width = int(de.strategy.global_configs[tid]["output_dim"])
+        out.append({
+            "table_id": tid,
+            "ids_per_step": int(ids),
+            "est_hbm_bytes_per_step": int(3 * ids * width * isz),
+            "est_flops_per_step": int(4 * ids * width),
+        })
+    return out
+
+
+def step_memory_report(de, loss_fn, dense_tx, emb_optimizer,
+                       cat_inputs, batch, mesh=None, lr_schedule=1.0,
+                       with_metrics: bool = False,
+                       nan_guard: Optional[bool] = None,
+                       telemetry=None,
+                       dense_params=None, state=None,
+                       param_dtype=jnp.float32) -> Dict[str, Any]:
+    """The full static capacity report for one hybrid train step:
+    :func:`table_memory_report` + :func:`compiled_step_report` of the
+    step built exactly like ``make_hybrid_train_step`` builds it
+    (metrics/guard/telemetry variants included) + per-table traffic
+    estimates. Inputs follow :func:`~.audit.audit_train_step`'s
+    contract — ``ShapeDtypeStruct`` pytrees are fine, nothing executes.
+    """
+    from ..utils import obs
+    from . import telemetry as tel
+
+    if nan_guard is None:
+        nan_guard = obs.nanguard_enabled()
+    tel_cfg = tel.resolve_config(telemetry)
+
+    if state is None:
+        if dense_params is None:
+            raise ValueError(
+                "step_memory_report needs dense_params (to derive an "
+                "abstract state) or an explicit state=")
+        state = jax.eval_shape(
+            lambda k, dp: trainer_mod.init_hybrid_state(
+                de, emb_optimizer, dp, dense_tx, k, dtype=param_dtype),
+            jax.random.key(0), dense_params)
+
+    step = trainer_mod.make_hybrid_train_step(
+        de, loss_fn, dense_tx, emb_optimizer, mesh=mesh,
+        lr_schedule=lr_schedule, with_metrics=with_metrics,
+        nan_guard=nan_guard, telemetry=tel_cfg if tel_cfg else False)
+    args = [state, cat_inputs, batch]
+    if tel_cfg is not None:
+        args.append(jax.eval_shape(
+            lambda: tel.init_telemetry(de, tel_cfg)))
+
+    return {
+        "layout": table_memory_report(de, emb_optimizer,
+                                      param_dtype=param_dtype),
+        "compiled": compiled_step_report(step, tuple(args)),
+        "per_table_traffic": _input_traffic_estimates(
+            de, cat_inputs, param_dtype),
+        "variant": {
+            "with_metrics": bool(with_metrics),
+            "nan_guard": bool(nan_guard),
+            "telemetry": tel_cfg._asdict() if tel_cfg else None,
+            "world": de.world_size,
+        },
+    }

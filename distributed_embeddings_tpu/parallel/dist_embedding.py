@@ -1626,6 +1626,87 @@ class DistributedEmbedding:
                 / float(max(plan.s_max, 1))),
         }
 
+    def update_telemetry(self, tstate, residuals, config):
+        """Fold one forward's routed ids into jit-carried access
+        telemetry (:mod:`~..analysis.telemetry`): per width slab, the
+        count-min sketch + top-k hot-row merge over the live logical
+        slab rows this rank received; plus the rank's cumulative
+        routed-id load. Pure jax ops on tensors the step already holds
+        — no collectives, no host interop, static shapes (zero
+        steady-state recompiles).
+
+        One emission point per ``(width, kind)`` exchange group, each
+        under its own ``obs.scope`` so a profile prices telemetry per
+        group; groups of equal width fold into one sketch update.
+
+        Args:
+          tstate: this device's telemetry state
+            (:func:`~..analysis.telemetry.local_state` view).
+          residuals: second output of :meth:`forward_with_residuals`.
+          config: a :class:`~..analysis.telemetry.TelemetryConfig`
+            (trace-time static).
+
+        Returns:
+          the updated telemetry state (same structure).
+        """
+        from ..analysis import telemetry as tel
+
+        _, ids_recv, encs, b = residuals
+        plan = self._get_plan(list(encs), b)
+        world = self.world_size
+        my = self._my_rank()
+        per_width: Dict[int, tuple] = {}
+        for gi, g in enumerate(plan.groups):
+            with obs.scope(f"telemetry_w{g.width}_{g.kind}"):
+                region = lax.slice(ids_recv, (0, g.goff),
+                                   (world, g.goff + g.n * g.blen))
+                rows = self._plan_row(plan.rows[gi], my)
+                roff = self._plan_row(plan.roff[gi], my)
+                slot_ok = self._plan_row(plan.valid[gi], my) > 0
+                rbase = (self._plan_row(plan.rbase[gi], my)
+                         if plan.rsliced[gi].any() else None)
+                if g.kind == "d":
+                    ids = region.reshape(world, g.n, b, g.hot)
+                    loc = (ids - rbase[None, :, None, None]
+                           if rbase is not None else ids)
+                    # live = in-range on THIS slot: row-sliced slots count
+                    # each id on exactly the slice that owns it, dead and
+                    # out-of-vocab ids drop (they train nothing either)
+                    live = ((loc >= 0)
+                            & (loc < rows[None, :, None, None])
+                            & slot_ok[None, :, None, None])
+                    grow = loc + roff[None, :, None, None]
+                else:
+                    r3 = region.reshape(world, g.n, g.blen)
+                    values = r3[:, :, :g.hot]
+                    lengths = r3[:, :, g.hot:g.hot + b]
+                    tot = jnp.sum(lengths, axis=2, dtype=jnp.int32)
+                    pos_live = (
+                        jnp.arange(g.hot, dtype=jnp.int32)[None, None, :]
+                        < jnp.minimum(tot, g.hot)[:, :, None])
+                    loc = (values - rbase[None, :, None]
+                           if rbase is not None else values)
+                    live = (pos_live & (loc >= 0)
+                            & (loc < rows[None, :, None])
+                            & slot_ok[None, :, None])
+                    grow = loc + roff[None, :, None]
+                acc = per_width.setdefault(g.width, ([], []))
+                acc[0].append(grow.astype(jnp.int32).reshape(-1))
+                acc[1].append(live.reshape(-1))
+        new = dict(tstate)
+        total = jnp.zeros((1,), jnp.float32)
+        for w in sorted(per_width):
+            idl, livel = per_width[w]
+            ids = jnp.concatenate(idl)
+            live = jnp.concatenate(livel)
+            with obs.scope(f"telemetry_update_w{w}"):
+                new[_wkey(w)] = tel.record_ids(tstate[_wkey(w)], ids,
+                                               live, config)
+            total = total + jnp.sum(live, dtype=jnp.float32).reshape(1)
+        new["steps"] = tstate["steps"] + 1
+        new["ids_total"] = tstate["ids_total"] + total
+        return new
+
     # ------------------------------------------------------------- checkpoint
 
     def _slice_plan(self):

@@ -94,6 +94,7 @@ class ResilientResult:
     last_loss: Optional[float]  #: last step's loss (may be non-finite)
     stop_reason: str           #: exhausted | preempted | on_step | until_step
     elapsed_s: float           #: wall-clock of the training loop
+    telemetry: Any = None      #: final jit-carried telemetry state (if any)
 
 
 class _PreemptCatcher:
@@ -153,7 +154,9 @@ def run_resilient(step_fn: Callable, state, data, *,
                   on_step: Optional[Callable] = None,
                   exit_on_preempt: bool = False,
                   save_on_exit: bool = True,
-                  is_chief: Optional[bool] = None) -> ResilientResult:
+                  is_chief: Optional[bool] = None,
+                  telemetry_state=None,
+                  telemetry_path: Optional[str] = None) -> ResilientResult:
     """Drive ``step_fn`` over ``data`` with checkpointing, preemption
     handling, auto-resume, and poisoned-batch escalation.
 
@@ -205,6 +208,22 @@ def run_resilient(step_fn: Callable, state, data, *,
       save_on_exit: checkpoint once more on clean completion (and clear
         the resume sentinel).
       is_chief: multi-host chief override (default: process 0 writes).
+      telemetry_state: jit-carried access-telemetry state
+        (:func:`~..analysis.telemetry.init_telemetry`) for a ``step_fn``
+        built with ``telemetry=`` on — the driver then calls
+        ``step_fn(state, cat_inputs, batch, telem)``, threads the
+        returned (last-element) telemetry state, and FLUSHES a host
+        summary (:func:`~..analysis.telemetry.summarize_telemetry`) plus
+        the raw state (``<path>.state.npz``) alongside every checkpoint;
+        on auto-resume the saved state is restored into the provided
+        (fresh) template, so an interrupted+resumed run CONTINUES the
+        accumulation — hot-row/skew reports survive preemption exactly
+        like the train state does. The final state rides back on
+        ``ResilientResult.telemetry``.
+      telemetry_path: where the flushed summary JSON goes; defaults to
+        ``<checkpoint_dir>.telemetry.json`` (atomic tmp+rename, chief
+        only). With neither a path nor a checkpoint dir, telemetry is
+        threaded but never flushed.
 
     Returns:
       :class:`ResilientResult`. Never returns on preemption when
@@ -223,6 +242,9 @@ def run_resilient(step_fn: Callable, state, data, *,
         def _chief() -> bool:
             return bool(is_chief)
 
+    if telemetry_path is None and checkpoint_dir is not None:
+        telemetry_path = checkpoint_dir.rstrip(os.sep) + ".telemetry.json"
+
     # ---- auto-resume -----------------------------------------------------
     ckpt_meta = os.path.join(checkpoint_dir, "meta.json") \
         if checkpoint_dir else None
@@ -240,6 +262,11 @@ def run_resilient(step_fn: Callable, state, data, *,
             dense_tx, mesh=mesh)
         logger.info("run_resilient: resumed at step %d from %s",
                     int(state.step), checkpoint_dir)
+        if telemetry_state is not None and telemetry_path is not None \
+                and os.path.isfile(telemetry_path + ".state.npz"):
+            from ..analysis import telemetry as tel
+            telemetry_state = tel.restore_telemetry_state(
+                telemetry_path + ".state.npz", telemetry_state)
 
     start_step = int(state.step)
     batches = fast_forward(data, start_step)
@@ -247,10 +274,36 @@ def run_resilient(step_fn: Callable, state, data, *,
     saves = 0
     last_save_t = time.monotonic()
 
+    def _flush_telemetry():
+        if telemetry_state is None or telemetry_path is None \
+                or not _chief():
+            return
+        from ..analysis import telemetry as tel
+        try:
+            summary = tel.summarize_telemetry(de, telemetry_state)
+            tmp = telemetry_path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(dict(summary, time=time.time()), f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, telemetry_path)
+            tel.save_telemetry_state(_telemetry_state_path(),
+                                     telemetry_state)
+        except Exception:  # noqa: BLE001 - telemetry is auxiliary: a flush
+            # failure (summarize bug, disk full, read-only fs) must not
+            # kill an otherwise healthy training run
+            logger.exception("run_resilient: telemetry flush failed")
+
+    def _telemetry_state_path() -> str:
+        # raw carried-state sidecar beside the summary, so a resumed run
+        # CONTINUES the accumulation instead of restarting from zero
+        return telemetry_path + ".state.npz"
+
     def _save():
         nonlocal saves, last_save_t
         runtime.fault_point("driver.save")
         save_train_state(checkpoint_dir, de, state, is_chief=is_chief)
+        _flush_telemetry()
         saves += 1
         last_save_t = time.monotonic()
 
@@ -301,7 +354,13 @@ def run_resilient(step_fn: Callable, state, data, *,
             if check_ids:
                 de.check_inputs(cat_inputs)
 
-            out = step_fn(state, cat_inputs, batch)
+            if telemetry_state is not None:
+                # telemetry-threaded steps return the carried state LAST
+                out = step_fn(state, cat_inputs, batch, telemetry_state)
+                telemetry_state = out[-1]
+                out = out[:-1]
+            else:
+                out = step_fn(state, cat_inputs, batch)
             loss, state = out[0], out[1]
             metrics = out[2] if len(out) > 2 else None
             steps_run += 1
@@ -392,13 +451,17 @@ def run_resilient(step_fn: Callable, state, data, *,
         runtime.fault_point("driver.final")
         if checkpoint_dir is not None and save_on_exit:
             _save()
+        else:
+            _flush_telemetry()  # no final checkpoint, but the report
+            # should still reflect the completed run
         _sentinel(False)
 
     result = ResilientResult(
         state=state, step=int(state.step), steps_run=steps_run,
         preempted=preempted, skipped_steps=skipped,
         checkpoints_saved=saves, last_loss=last_loss,
-        stop_reason=stop_reason, elapsed_s=elapsed)
+        stop_reason=stop_reason, elapsed_s=elapsed,
+        telemetry=telemetry_state)
     if preempted and exit_on_preempt and checkpoint_dir is not None:
         # exit code 83 asserts "checkpointed, requeue me" — only true
         # when a checkpoint dir exists; an uncheckpointed preemption

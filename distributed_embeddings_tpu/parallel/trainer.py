@@ -44,7 +44,7 @@ def _sq_sum(tree) -> jax.Array:
 
 def _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer, lr_schedule,
                        state, cat_inputs, batch, with_metrics=False,
-                       nan_guard=False):
+                       nan_guard=False, telemetry_cfg=None, telem=None):
     """One per-device hybrid step (shared by :func:`make_hybrid_train_step`
     and :func:`make_hybrid_train_loop`): forward, one backward producing dp
     gradients (pmean-averaged) and mp cotangents (manual sparse path), both
@@ -65,6 +65,14 @@ def _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer, lr_schedule,
     returned loss stays the true non-finite value so the host driver can
     count consecutive skips and escalate, and under ``with_metrics`` the
     per-device ``skipped_steps`` metric flags the skip.
+
+    ``telemetry_cfg`` (static) + ``telem`` (this device's jit-carried
+    access-telemetry state, :mod:`~..analysis.telemetry`): when given,
+    the step folds the forward's routed ids into the hot-row sketches
+    and load accumulators and RETURNS the updated telemetry state as its
+    LAST element. Telemetry reads the same residual tensors the metrics
+    do and touches nothing in the parameter/optimizer path — with it off
+    the step is bit-for-bit the pre-telemetry program.
     """
     world = de.world_size
     # slabs are {width: [world, rows, w]} globally -> [rows, w] per device
@@ -72,6 +80,10 @@ def _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer, lr_schedule,
     emb_opt_local = de.local_view(state.emb_opt_state)
     with obs.scope("embedding_forward"):
         outs, res = de.forward_with_residuals(emb_local, cat_inputs)
+    new_telem = None
+    if telemetry_cfg is not None:
+        with obs.scope("telemetry"):
+            new_telem = de.update_telemetry(telem, res, telemetry_cfg)
 
     with obs.scope("dense_forward_backward"):
         loss, (dense_grads, out_grads) = jax.value_and_grad(
@@ -131,7 +143,8 @@ def _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer, lr_schedule,
         dense_params=dense_params, dense_opt_state=dense_opt_state,
         step=state.step + 1)
     if not with_metrics:
-        return loss, new_state
+        return ((loss, new_state, new_telem) if new_telem is not None
+                else (loss, new_state))
     metrics = de.step_metrics(
         res, out_dtype=out_grads[0].dtype if out_grads else None)
     # out_grads are device-varying; the pmean'd loss / resolved dense
@@ -144,6 +157,8 @@ def _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer, lr_schedule,
                else jnp.zeros((1,), jnp.int32))
     metrics["skipped_steps"] = de._vary(skipped)
     metrics["step"] = de._vary(state.step.astype(jnp.int32).reshape(1))
+    if new_telem is not None:
+        return loss, new_state, metrics, new_telem
     return loss, new_state, metrics
 
 
@@ -166,7 +181,8 @@ def make_hybrid_train_step(de: DistributedEmbedding,
                            mesh=None,
                            lr_schedule=1.0,
                            with_metrics: Optional[bool] = None,
-                           nan_guard: Optional[bool] = None):
+                           nan_guard: Optional[bool] = None,
+                           telemetry=None):
     """Build ``step(state, cat_inputs, batch) -> (loss, state)``.
 
     Args:
@@ -194,25 +210,56 @@ def make_hybrid_train_step(de: DistributedEmbedding,
         ``skipped_steps`` in the metrics. ``None`` (default) follows
         ``DETPU_NANGUARD``, which defaults ON (see
         :func:`~..utils.obs.nanguard_enabled`).
+      telemetry: carry jit-threaded access telemetry
+        (:mod:`~..analysis.telemetry`: per-table hot-row sketches +
+        per-rank load accounting) through the step. EXPLICIT opt-in —
+        off by default (``None``/``False``); ``True`` uses the
+        ``DETPU_TELEMETRY_*`` sketch geometry; a
+        :class:`~..analysis.telemetry.TelemetryConfig` pins it. (No env
+        default: telemetry changes the step's CALL arity, so an env
+        variable must never flip it under a 3-arg call site — the
+        telemetry-aware entry points read ``DETPU_TELEMETRY``
+        themselves.) When on,
+        the step takes a fourth argument — the telemetry state from
+        :func:`~..analysis.telemetry.init_telemetry` (donated, like the
+        train state) — and returns the updated state as its LAST
+        element: ``step(state, cat_inputs, batch, telem) -> (loss,
+        state[, metrics], telem)``. The parameter/optimizer math is
+        untouched: telemetry-off steps are bit-for-bit the pre-telemetry
+        program, telemetry-on steps change only the extra output.
 
     The returned step takes data-parallel shards: each categorical input
     ``[local_batch, hotness]`` and ``batch`` any pytree of per-device arrays
     the loss consumes (already sharded by the caller).
     """
+    from ..analysis import telemetry as tel
+
     world = de.world_size
     if with_metrics is None:
         with_metrics = obs.metrics_enabled()
     if nan_guard is None:
         nan_guard = obs.nanguard_enabled()
+    tel_cfg = tel.resolve_config(telemetry)
 
-    def local_step(state: HybridTrainState, cat_inputs, batch):
-        return _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer,
-                                  lr_schedule, state, cat_inputs, batch,
-                                  with_metrics=with_metrics,
-                                  nan_guard=nan_guard)
+    if tel_cfg is None:
+        def local_step(state: HybridTrainState, cat_inputs, batch):
+            return _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer,
+                                      lr_schedule, state, cat_inputs, batch,
+                                      with_metrics=with_metrics,
+                                      nan_guard=nan_guard)
+    else:
+        def local_step(state: HybridTrainState, cat_inputs, batch, telem):
+            out = _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer,
+                                     lr_schedule, state, cat_inputs, batch,
+                                     with_metrics=with_metrics,
+                                     nan_guard=nan_guard,
+                                     telemetry_cfg=tel_cfg,
+                                     telem=tel.local_state(telem))
+            return out[:-1] + (tel.stacked_state(out[-1]),)
 
+    donate = (0,) if tel_cfg is None else (0, 3)
     if world == 1:
-        return jax.jit(local_step, donate_argnums=(0,))
+        return jax.jit(local_step, donate_argnums=donate)
 
     if mesh is None:
         raise ValueError("mesh is required for world_size > 1")
@@ -222,12 +269,16 @@ def make_hybrid_train_step(de: DistributedEmbedding,
         dense_params=P(), dense_opt_state=P(), step=P())
     out_specs = ((P(), state_specs, _metric_specs(ax)) if with_metrics
                  else (P(), state_specs))
+    in_specs = (state_specs, P(ax), P(ax))
+    if tel_cfg is not None:
+        out_specs = out_specs + (P(ax),)
+        in_specs = in_specs + (P(ax),)
 
     sm = jax.shard_map(
         local_step, mesh=mesh,
-        in_specs=(state_specs, P(ax), P(ax)),
+        in_specs=in_specs,
         out_specs=out_specs)
-    return jax.jit(sm, donate_argnums=(0,))
+    return jax.jit(sm, donate_argnums=donate)
 
 
 def make_hybrid_train_loop(de: DistributedEmbedding,
@@ -238,7 +289,8 @@ def make_hybrid_train_loop(de: DistributedEmbedding,
                            lr_schedule=1.0,
                            unroll: int = 1,
                            with_metrics: Optional[bool] = None,
-                           nan_guard: Optional[bool] = None):
+                           nan_guard: Optional[bool] = None,
+                           telemetry=None):
     """Multi-step training driver: ``loop(state, cat_stacks, batch_stacks)
     -> (losses [K], state)`` running K steps inside ONE compiled program via
     ``lax.scan``.
@@ -260,37 +312,72 @@ def make_hybrid_train_loop(de: DistributedEmbedding,
     non-finite guard included (``nan_guard``, default ``DETPU_NANGUARD``):
     a poisoned batch inside the scan skips its own updates and the
     remaining scanned steps proceed from the untouched state.
+
+    ``telemetry`` (explicit opt-in, same contract as
+    :func:`make_hybrid_train_step`) threads the access-telemetry state
+    through the scan carry exactly like the single step: ``loop(state,
+    cat_stacks, batch_stacks, telem) -> (losses, state[, metrics],
+    telem)`` — every scanned step folds its ids in, ONE carried state
+    for the whole dispatch.
     """
+    from ..analysis import telemetry as tel
+
     world = de.world_size
     if with_metrics is None:
         with_metrics = obs.metrics_enabled()
     if nan_guard is None:
         nan_guard = obs.nanguard_enabled()
+    tel_cfg = tel.resolve_config(telemetry)
 
-    def body(state, xs):
+    def body(carry, xs):
         cat_inputs, batch = xs
+        state, telem = carry if tel_cfg is not None else (carry, None)
         out = _hybrid_local_step(
             de, loss_fn, dense_tx, emb_optimizer, lr_schedule, state,
             cat_inputs, batch, with_metrics=with_metrics,
-            nan_guard=nan_guard)
+            nan_guard=nan_guard, telemetry_cfg=tel_cfg, telem=telem)
+        if tel_cfg is not None:
+            telem = out[-1]
+            out = out[:-1]
         if with_metrics:
             loss, state, metrics = out
-            return state, (loss, metrics)
-        loss, state = out
-        return state, loss
+            ys = (loss, metrics)
+        else:
+            loss, state = out
+            ys = loss
+        return ((state, telem) if tel_cfg is not None else state), ys
 
-    def local_loop(state, cat_stacks, batch_stacks):
+    def run_scan(carry, cat_stacks, batch_stacks):
         # shared by world == 1 and shard_map (_hybrid_local_step already
         # pmeans the loss and resolves dp gradients for world > 1)
-        state, ys = lax.scan(body, state, (cat_stacks, batch_stacks),
+        carry, ys = lax.scan(body, carry, (cat_stacks, batch_stacks),
                              unroll=unroll)
         if with_metrics:
             losses, metrics = ys  # metrics leaves stacked [K, 1]
-            return losses, state, metrics
-        return ys, state
+            return carry, (losses, metrics)
+        return carry, (ys, None)
 
+    if tel_cfg is None:
+        def local_loop(state, cat_stacks, batch_stacks):
+            state, (losses, metrics) = run_scan(state, cat_stacks,
+                                                batch_stacks)
+            if with_metrics:
+                return losses, state, metrics
+            return losses, state
+    else:
+        def local_loop(state, cat_stacks, batch_stacks, telem):
+            # local/stacked views once per dispatch, not per scanned step
+            carry = (state, tel.local_state(telem))
+            (state, telem), (losses, metrics) = run_scan(
+                carry, cat_stacks, batch_stacks)
+            telem = tel.stacked_state(telem)
+            if with_metrics:
+                return losses, state, metrics, telem
+            return losses, state, telem
+
+    donate = (0,) if tel_cfg is None else (0, 3)
     if world == 1:
-        return jax.jit(local_loop, donate_argnums=(0,))
+        return jax.jit(local_loop, donate_argnums=donate)
 
     if mesh is None:
         raise ValueError("mesh is required for world_size > 1")
@@ -301,12 +388,16 @@ def make_hybrid_train_loop(de: DistributedEmbedding,
     out_specs = ((P(), state_specs,
                   {k: P(None, ax) for k in obs.STEP_METRIC_KEYS})
                  if with_metrics else (P(), state_specs))
+    in_specs = (state_specs, P(None, ax), P(None, ax))
+    if tel_cfg is not None:
+        out_specs = out_specs + (P(ax),)
+        in_specs = in_specs + (P(ax),)
 
     sm = jax.shard_map(
         local_loop, mesh=mesh,
-        in_specs=(state_specs, P(None, ax), P(None, ax)),
+        in_specs=in_specs,
         out_specs=out_specs)
-    return jax.jit(sm, donate_argnums=(0,))
+    return jax.jit(sm, donate_argnums=donate)
 
 
 def make_hybrid_eval_step(de: DistributedEmbedding,

@@ -105,9 +105,35 @@ def get_int(name: str, default: Optional[int] = None) -> int:
 declare("DETPU_OBS", default="",
         doc="1 = build train steps with on-device step metrics (3-tuple "
             "return) and emit metrics sidecars")
+declare("DETPU_OBS_MAX_BYTES", default="0",
+        doc="MetricsLogger sidecar size cap in bytes; on overflow the "
+            "file rotates to <path>.1 (one generation kept). 0 = "
+            "unbounded (the historical behavior)")
 declare("DETPU_OBS_SIDECAR", default="BENCH.metrics.jsonl",
         doc="path of the step-metrics JSONL sidecar bench.py writes under "
             "DETPU_OBS=1")
+
+# access telemetry (analysis/telemetry.py; carried through train steps
+# built by parallel/trainer.py when enabled)
+declare("DETPU_TELEMETRY", default="",
+        doc="1 = telemetry-aware entry points (examples/dlrm, "
+            "tools/obs_report.py, bench telemetry section) build their "
+            "steps with jit-carried access telemetry. Plain step "
+            "builders need the explicit telemetry= opt-in (it changes "
+            "the step's call arity)")
+declare("DETPU_TELEMETRY_CANDIDATES", default="0",
+        doc="per-step unique-id candidates merged into the hot-row "
+            "top-k; 0 = 4 * DETPU_TELEMETRY_TOPK")
+declare("DETPU_TELEMETRY_INTERVAL", default="100",
+        doc="metrics-log cadence (steps) of tools/obs_report.py's demo "
+            "run (clamped to sample short runs)")
+declare("DETPU_TELEMETRY_SKETCH_DEPTH", default="4",
+        doc="count-min sketch rows (independent hashes) per width slab")
+declare("DETPU_TELEMETRY_SKETCH_WIDTH", default="2048",
+        doc="count-min sketch buckets per row; estimate error ~ "
+            "total_ids/buckets")
+declare("DETPU_TELEMETRY_TOPK", default="32",
+        doc="hot-row slots tracked per width slab per rank")
 declare("DETPU_PROFILE_DIR", default=None,
         doc="directory for XLA profile captures (obs.profile_trace); "
             "unset = no capture")

@@ -240,11 +240,33 @@ class MetricsLogger:
       (``[world]``-shaped per-rank vectors stay vectors);
     * ``{"section": "counters", "counters": {...}}`` from
       :meth:`log_counters` — the process counters, recompiles included.
+
+    ``max_bytes`` (default ``DETPU_OBS_MAX_BYTES``; 0 = unbounded)
+    bounds the sidecar for long resilient runs: when the file would
+    exceed the cap, it rotates to ``<path>.1`` (one generation kept —
+    the tail of history survives, the file can never grow without
+    bound) and logging continues into a fresh file. Rotation happens
+    between records, so both files stay line-parseable.
     """
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, max_bytes: Optional[int] = None):
         self.path = path
+        self.max_bytes = (envvars.get_int("DETPU_OBS_MAX_BYTES")
+                          if max_bytes is None else int(max_bytes))
         self._rec = _runtime.SectionRecorder(path)
+
+    def _maybe_rotate(self) -> None:
+        if self.max_bytes <= 0:
+            return
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return
+        if size < self.max_bytes:
+            return
+        os.replace(self.path, self.path + ".1")
+        logger.info("obs: rotated metrics sidecar %s (> %d bytes)",
+                    self.path, self.max_bytes)
 
     def log_step(self, metrics: Dict[str, Any], step: Optional[int] = None,
                  **extra: Any) -> Dict[str, Any]:
@@ -258,10 +280,12 @@ class MetricsLogger:
         rec = dict(extra)
         if step is not None:
             rec["step"] = int(step)
+        self._maybe_rotate()
         return self._rec.record("step_metrics", metrics=host, **rec)
 
     def log_counters(self, **extra: Any) -> Dict[str, Any]:
         """Append the current process-counter snapshot."""
+        self._maybe_rotate()
         return self._rec.record("counters", counters=counters(), **extra)
 
     @staticmethod
@@ -299,7 +323,10 @@ def fetch_metrics(metrics: Dict[str, Any]) -> Dict[str, Any]:
 def summarize(metrics: Dict[str, Any]) -> Dict[str, Any]:
     """Host-side scalar summary of one step-metrics dict: per-rank vectors
     reduce to totals (sums for counts/bytes, max for overflow — the rank
-    that truncated is the one to look at), norms/fractions to their max."""
+    that truncated is the one to look at), norms/fractions to their max.
+    Per-rank vectors with more than one entry additionally report their
+    p50/p95 (``<key>_p50`` / ``<key>_p95``) — the distribution view the
+    imbalance analyses in ``tools/obs_report.py`` read."""
     import numpy as np
 
     out: Dict[str, Any] = {}
@@ -317,6 +344,9 @@ def summarize(metrics: Dict[str, Any]) -> Dict[str, Any]:
             out[k] = float(v.max())
         else:
             out[k] = float(v[0])
+        if v.size > 1:
+            out[f"{k}_p50"] = float(np.percentile(v, 50))
+            out[f"{k}_p95"] = float(np.percentile(v, 95))
     return out
 
 

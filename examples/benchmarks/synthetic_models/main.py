@@ -84,9 +84,12 @@ def main(_):
 
     state = init_hybrid_state(de, emb_opt, dense_params, tx,
                               jax.random.key(1), mesh=mesh)
+    # telemetry pinned off: this benchmark times the raw step (use the
+    # dlrm example or DETPU_TELEMETRY with your own loop for hot-row
+    # telemetry)
     step_fn = make_hybrid_train_step(de, loss_fn, tx, emb_opt, mesh=mesh,
                                      lr_schedule=FLAGS.learning_rate,
-                                     with_metrics=False)
+                                     with_metrics=False, telemetry=False)
 
     if FLAGS.checkpoint_dir:
         # self-healing path: checkpointed, preemption-safe, resumable —

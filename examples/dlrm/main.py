@@ -37,6 +37,7 @@ from distributed_embeddings_tpu.models.dlrm import (
     DLRMConfig, DLRMDense, bce_with_logits)
 from distributed_embeddings_tpu.models.schedules import (
     warmup_poly_decay_schedule)
+from distributed_embeddings_tpu.analysis import telemetry
 from distributed_embeddings_tpu.parallel import (
     DistributedEmbedding, SparseSGD, bootstrap, init_hybrid_state,
     make_hybrid_eval_step, make_hybrid_train_step, run_resilient)
@@ -205,9 +206,18 @@ def main(_):
     else:
         state = init_hybrid_state(de, emb_opt, dense_params, tx,
                                   jax.random.key(1), mesh=mesh)
+    # DETPU_TELEMETRY=1: build the step with jit-carried access
+    # telemetry (hot-row sketches + per-rank loads); the resilient
+    # driver threads the state and flushes <save_state>.telemetry.json
+    # alongside each checkpoint. Step arity changes with it, so the
+    # step build and the carried state are decided TOGETHER here.
+    with_telemetry = telemetry.telemetry_enabled()
     step_fn = make_hybrid_train_step(de, loss_fn, tx, emb_opt, mesh=mesh,
                                      lr_schedule=sched,
-                                     with_metrics=with_metrics)
+                                     with_metrics=with_metrics,
+                                     telemetry=with_telemetry)
+    telem = (telemetry.init_telemetry(de, mesh=mesh) if with_telemetry
+             else None)
 
     nproc = bootstrap.process_count()
     pid = bootstrap.process_index()
@@ -338,6 +348,7 @@ def main(_):
         metrics_logger=metrics_log,
         metrics_interval=FLAGS.metrics_interval,
         on_step=on_step,
+        telemetry_state=telem,
         # exit code 83 asserts "checkpointed, requeue me" — only true when
         # a checkpoint dir exists; without one a SIGTERM just ends the
         # loop and the script finishes gracefully (weights dump below)

@@ -18,9 +18,11 @@ os.environ["XLA_FLAGS"] = (
 # a user-level DETPU_OBS=1 would flip every env-defaulted train step to the
 # instrumented 3-tuple return and break the suite's 2-tuple call sites —
 # the suite opts in explicitly (with_metrics=True) where it tests metrics.
+# DETPU_TELEMETRY likewise changes the step arity (telemetry state in/out).
 # Popped here (before any test imports), so subprocess tests inherit the
 # sanitized environment too.
 os.environ.pop("DETPU_OBS", None)
+os.environ.pop("DETPU_TELEMETRY", None)
 
 import jax
 

@@ -358,6 +358,45 @@ def test_summarize_reduces_per_rank_vectors():
     assert s["loss"] == 1.5
 
 
+def test_summarize_percentiles_of_per_rank_vectors():
+    # 8-rank vector: p50/p95 ride alongside the scalar aggregation
+    ids = np.asarray([10.0, 10, 10, 10, 10, 10, 10, 94])
+    s = obs.summarize({"ids_routed": ids, "loss": np.asarray([1.0])})
+    assert s["ids_routed"] == float(ids.sum())
+    assert s["ids_routed_p50"] == pytest.approx(np.percentile(ids, 50))
+    assert s["ids_routed_p95"] == pytest.approx(np.percentile(ids, 95))
+    # scalar ([1]-shaped) metrics carry no percentile keys
+    assert "loss_p50" not in s and "loss_p95" not in s
+
+
+def test_metrics_logger_rotation_caps_growth(tmp_path):
+    path = str(tmp_path / "metrics.jsonl")
+    cap = 2000
+    log = obs.MetricsLogger(path, max_bytes=cap)
+    for s in range(60):
+        log.log_step({"ids_routed": list(range(8))}, step=s)
+    # the live file stays bounded by ~one record past the cap, and the
+    # rotated generation holds the earlier records
+    assert os.path.getsize(path) <= cap + 200
+    assert os.path.exists(path + ".1")
+    live = obs.MetricsLogger.load(path)
+    rotated = obs.MetricsLogger.load(path + ".1")
+    assert live and rotated
+    # one generation kept: the retained tail is contiguous, ordered,
+    # and ends at the newest record
+    steps = [r["step"] for r in rotated + live]
+    assert steps == list(range(steps[0], 60))
+
+
+def test_metrics_logger_unbounded_by_default(tmp_path):
+    path = str(tmp_path / "metrics.jsonl")
+    log = obs.MetricsLogger(path)  # DETPU_OBS_MAX_BYTES default 0
+    for s in range(30):
+        log.log_counters(step=s)
+    assert not os.path.exists(path + ".1")
+    assert len(obs.MetricsLogger.load(path)) == 30
+
+
 # ------------------------------------------------- sparse_optax metrics
 
 

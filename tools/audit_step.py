@@ -33,9 +33,10 @@ def _force_cpu(devices: int) -> None:
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
         + f" --xla_force_host_platform_device_count={devices}")
-    # an inherited DETPU_OBS=1 would flip the audited step to the
-    # instrumented variant; audit both shapes explicitly instead
+    # an inherited DETPU_OBS=1 / DETPU_TELEMETRY=1 would flip the audited
+    # step to an instrumented variant; audit the shapes explicitly instead
     os.environ.pop("DETPU_OBS", None)
+    os.environ.pop("DETPU_TELEMETRY", None)
 
 
 def build_case(name: str, world: int, batch: int):
@@ -105,7 +106,8 @@ def build_case(name: str, world: int, batch: int):
     return de, cats, batch_tree, dense_params, loss_fn
 
 
-def audit_case(name: str, world: int, batch: int, with_metrics: bool):
+def audit_case(name: str, world: int, batch: int, with_metrics: bool,
+               with_telemetry: bool = False):
     import jax
     import numpy as np
     import optax
@@ -123,10 +125,12 @@ def audit_case(name: str, world: int, batch: int, with_metrics: bool):
             raise RuntimeError(
                 f"host platform exposes {len(devs)} devices < {world}")
         mesh = Mesh(np.array(devs[:world]), ("data",))
+    suffix = "/telemetry" if with_telemetry else ""
     return audit_train_step(
         de, loss_fn, optax.sgd(0.5), SparseAdagrad(), cats, batch_tree,
         mesh=mesh, lr_schedule=0.3, with_metrics=with_metrics,
-        dense_params=dense_params, label=f"{name}/world{world}")
+        telemetry=with_telemetry,
+        dense_params=dense_params, label=f"{name}/world{world}{suffix}")
 
 
 def main(argv=None) -> int:
@@ -138,6 +142,9 @@ def main(argv=None) -> int:
     ap.add_argument("--batch", type=int, default=16, help="global batch")
     ap.add_argument("--with-metrics", action="store_true",
                     help="audit the instrumented (DETPU_OBS) step variant")
+    ap.add_argument("--with-telemetry", action="store_true",
+                    help="audit ONLY the telemetry-instrumented "
+                         "(DETPU_TELEMETRY) step variants")
     ap.add_argument("--strict", action="store_true",
                     help="exit 1 on any violation (the make verify gate)")
     ap.add_argument("--json", metavar="PATH",
@@ -149,12 +156,19 @@ def main(argv=None) -> int:
 
     names = (["dense", "ragged", "row_sliced"] if args.config == "all"
              else [args.config])
+    # (config, telemetry?) cases: --with-telemetry audits only the
+    # telemetry-instrumented variants; the default "all" sweep ALSO
+    # audits one telemetry case so the verify gate covers the carried
+    # state (same census, donation grown by the telemetry leaves)
+    cases = [(n, args.with_telemetry) for n in names]
+    if args.config == "all" and not args.with_telemetry:
+        cases.append(("dense", True))
     reports = []
     failed = 0
-    for name in names:
+    for name, with_tel in cases:
         try:
             rep = audit_case(name, args.world, args.batch,
-                             args.with_metrics)
+                             args.with_metrics, with_telemetry=with_tel)
         except Exception as e:  # noqa: BLE001 - report, then fail the gate
             print(f"audit_step: {name}: audit errored: {e}",
                   file=sys.stderr)

@@ -46,13 +46,16 @@ THROUGHPUT_KEYS = (
     "input_pipeline_samples_per_sec",
     "nanguard_samples_per_sec",
     "resilient_samples_per_sec",
+    "telemetry_samples_per_sec",
 )
-# lower is better
+# lower is better (ms-per-iter timings and byte budgets: a >threshold
+# rise in per-step peak HBM is a regression exactly like a slower step)
 MS_KEYS = (
     "tiny_zoo_adagrad_ms_per_iter",
     "tiny_zoo_sgd_ms_per_iter",
     "tiny_zoo_adagrad_bf16_ms_per_iter",
     "criteo1tb_v5e16_step_ms",
+    "peak_hbm_mb",
 )
 ENV_KEYS = ("backend", "device_count", "jax_version", "smoke")
 
