@@ -5,7 +5,7 @@ SHELL := /bin/bash
 
 .PHONY: all native test test-fast bench bench-diff clean pkg verify \
         lint audit-step check-backend check-obs check-obs-report \
-        check-resilience obs-report
+        check-resilience check-reshard obs-report
 
 all: native
 
@@ -28,7 +28,7 @@ bench:
 # no-eager-backend shim), the observability gate, and the
 # preemption-recovery drill — run before shipping a round
 verify: lint audit-step check-backend check-obs check-obs-report \
-        check-resilience
+        check-resilience check-reshard
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -75,6 +75,12 @@ obs-report:
 # and require the final state to match an uninterrupted run bit for bit
 check-resilience:
 	python tools/check_resilience.py
+
+# elastic-topology drill: preempt an 8-virtual-device run, auto-resume it
+# on 4 devices (in-place checkpoint re-shard, degradation logged), and
+# require determinism + logical-state equality vs the uninterrupted run
+check-reshard:
+	python tools/check_reshard.py
 
 # optional regression gate: diff two BENCH records, nonzero exit on a >10%
 # throughput regression. Usage: make bench-diff OLD=BENCH_r04.json NEW=out.json

@@ -615,6 +615,51 @@ def run_resilient_overhead():
     }
 
 
+def run_reshard():
+    """Offline checkpoint re-shard cost (elastic topology tooling): save a
+    mid-size train state once, then rewrite it 1 -> 8 ranks (row-sliced)
+    and back with ``utils.checkpoint.reshard_checkpoint`` — pure host
+    file streaming, no device work — and price the rewrite in MB/s. The
+    table data is copied byte-identically, so the round trip also
+    re-asserts the bitwise A -> B -> A contract on real file sizes."""
+    import tempfile
+
+    from distributed_embeddings_tpu.parallel import init_hybrid_state
+    from distributed_embeddings_tpu.parallel.strategy import (
+        DistEmbeddingStrategy)
+    from distributed_embeddings_tpu.utils import (
+        save_train_state, verify_checkpoint)
+    from distributed_embeddings_tpu.utils.checkpoint import (
+        reshard_checkpoint)
+
+    rows = 2_000 if SMOKE else 50_000
+    configs = [{"input_dim": rows + 997 * i, "output_dim": 64}
+               for i in range(8)]
+    de = DistributedEmbedding(configs, world_size=1)
+    emb_opt = SparseSGD()
+    tx = optax.sgd(0.1)
+    state = init_hybrid_state(de, emb_opt,
+                              {"w": jnp.ones((8 * 64, 1), jnp.float32)},
+                              tx, jax.random.key(0))
+    with tempfile.TemporaryDirectory(prefix="detpu_bench_reshard_") as tmp:
+        src = os.path.join(tmp, "ck")
+        save_train_state(src, de, state)
+        mb = sum(
+            os.path.getsize(os.path.join(dp_, f))
+            for dp_, _, fs in os.walk(src) for f in fs) / 1e6
+        target8 = DistEmbeddingStrategy(configs, 8, strategy="basic",
+                                        row_slice_threshold=rows * 16)
+        t0 = time.perf_counter()
+        reshard_checkpoint(src, os.path.join(tmp, "ck8"), target8)
+        reshard_checkpoint(os.path.join(tmp, "ck8"),
+                           os.path.join(tmp, "ck1"), de)
+        dt = time.perf_counter() - t0
+        verify_checkpoint(os.path.join(tmp, "ck1"))  # CRCs intact
+    return {"reshard_ckpt_mb": round(mb, 1),
+            "reshard_rewrites": 2,
+            "reshard_mb_per_s": round(2 * mb / max(dt, 1e-9), 1)}
+
+
 def run_step_memory():
     """Static capacity accounting of the headline step (ISSUE 5): the
     capped bf16 DLRM step is abstractly lowered + compiled for THIS
@@ -1002,6 +1047,9 @@ def main():
         out["telemetry_overhead"] = telov
         out["telemetry_samples_per_sec"] = telov[
             "telemetry_samples_per_sec"]
+    reshard = _guard("reshard", run_reshard)
+    if reshard is not None:
+        out["reshard"] = reshard
     resil = _guard("resilient_overhead", run_resilient_overhead)
     if resil is not None:
         # nested record for the bench report; the two samples/s terms are

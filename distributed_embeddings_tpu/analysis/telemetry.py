@@ -413,6 +413,27 @@ def zipf_alpha(counts: List[int]) -> Optional[float]:
     return float(-slope)
 
 
+def table_loads_from_summary(summary: Dict[str, Any],
+                             num_tables: int) -> List[float]:
+    """Per-global-table traffic weights for the ``telemetry_balanced``
+    planner (``parallel/strategy.py``), derived from a
+    :func:`summarize_telemetry` dict (e.g. the ``<ckpt>.telemetry.json``
+    the resilient driver flushes).
+
+    The weight of a table is the sum of its surfaced hot-row count
+    estimates — an under-count of total traffic (only the carried top-k
+    surfaces), but under the Zipfian skew that motivates re-sharding the
+    top-k holds most of the mass, and the planner only needs *relative*
+    weights. Tables that never surfaced a hot row weigh 0 and fall back
+    to byte balancing via the planner's tie-break."""
+    loads = [0.0] * num_tables
+    for t in summary.get("tables", []):
+        tid = int(t.get("table_id", -1))
+        if 0 <= tid < num_tables:
+            loads[tid] = float(sum(int(c) for _, c in t.get("top_rows", [])))
+    return loads
+
+
 def summarize_telemetry(de, state, topk: Optional[int] = None
                         ) -> Dict[str, Any]:
     """JSON-able run summary: per-table hot rows (with a per-table Zipf

@@ -143,8 +143,10 @@ class DistributedEmbedding:
       world_size: mesh-axis size (model-parallel positions == data-parallel
         positions, as in the reference).
       strategy: ``basic | memory_balanced | memory_optimized |
-        comm_balanced`` (the last balances per-(width, inputs) table counts
-        so the padded output exchange wastes the fewest bytes — see
+        comm_balanced | telemetry_balanced`` (``comm_balanced`` balances
+        per-(width, inputs) table counts so the padded output exchange
+        wastes the fewest bytes; ``telemetry_balanced`` balances measured
+        per-table traffic and needs ``table_loads`` — see
         ``parallel/strategy.py``).
       column_slice_threshold: max elements per slice; larger tables are split
         width-wise into power-of-2 slices.
@@ -198,6 +200,9 @@ class DistributedEmbedding:
       input_table_map: ``input[i]`` uses ``table[input_table_map[i]]``.
       input_hotness: optional per-input hotness hint; lets ``comm_balanced``
         model the exchange groups exactly (see ``strategy.py``).
+      table_loads: per-table measured traffic weights for the
+        ``telemetry_balanced`` strategy (see ``strategy.py``; derive them
+        with :func:`...analysis.telemetry.table_loads_from_summary`).
       axis_name: mesh axis the executor runs under (inside ``shard_map``).
       compute_dtype: output/communication dtype. Embedding reads and combiner
         reductions stay in the parameter dtype; outputs are cast to
@@ -221,7 +226,8 @@ class DistributedEmbedding:
                  input_hotness: Optional[Sequence[int]] = None,
                  masked_reads: bool = False,
                  invalid_id_policy: str = "clamp",
-                 ragged_overflow_raise: bool = False):
+                 ragged_overflow_raise: bool = False,
+                 table_loads: Optional[Sequence[float]] = None):
         if row_slice is not None and (isinstance(row_slice, bool)
                                       or not isinstance(row_slice, int)):
             # bool subclasses int: row_slice=True would silently mean
@@ -247,7 +253,8 @@ class DistributedEmbedding:
             input_table_map=input_table_map,
             column_slice_threshold=column_slice_threshold,
             input_hotness=input_hotness,
-            row_slice_threshold=row_slice)
+            row_slice_threshold=row_slice,
+            table_loads=table_loads)
         if len(self.strategy.global_configs) < self.world_size:
             raise NotImplementedError(
                 "Fewer tables than mesh positions is not supported "

@@ -62,7 +62,7 @@ from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
-from ..utils import obs, runtime
+from ..utils import envvars, obs, runtime
 from ..utils.checkpoint import restore_train_state, save_train_state
 from ..utils.data import fast_forward
 
@@ -148,6 +148,7 @@ def run_resilient(step_fn: Callable, state, data, *,
                   emb_optimizer=None,
                   dense_tx=None,
                   mesh=None,
+                  on_mismatch: Optional[str] = None,
                   escalate_after: Optional[int] = None,
                   metrics_logger=None,
                   metrics_interval: int = 100,
@@ -189,6 +190,19 @@ def run_resilient(step_fn: Callable, state, data, *,
         its ``.prev`` fallback) exists; requires ``emb_optimizer`` and
         ``dense_tx`` (the :func:`~..utils.checkpoint.restore_train_state`
         arguments).
+      on_mismatch: restore policy when the checkpoint was written under a
+        DIFFERENT sharding plan / world size than ``de`` — the elastic
+        topology path: a run preempted on 16 chips that comes back on 8
+        builds its ``de``/mesh for 8 and the restore re-shards the
+        logical tables in place (``"reshard"``) instead of dying. Default
+        ``None`` follows ``DETPU_ON_MISMATCH`` (which defaults to
+        ``"reshard"``); pass ``"error"`` for the strict pre-elastic
+        behavior. Every re-shard is logged as a degradation — warning log
+        plus a ``checkpoint_reshard`` record (old plan, new plan,
+        per-rank byte deltas) in ``metrics_logger`` when one is given.
+        After the re-shard point the run is checkpoint-CRC-deterministic
+        again: two resumes onto the same shrunken mesh write identical
+        checkpoints.
       escalate_after: consecutive non-finite-loss steps before
         :class:`~..utils.runtime.NonFiniteLossError`; default
         ``DETPU_NANGUARD_K`` (3). The state is checkpointed first — under
@@ -233,6 +247,8 @@ def run_resilient(step_fn: Callable, state, data, *,
         resume = False
     if escalate_after is None:
         escalate_after = obs.nanguard_escalation_k()
+    if on_mismatch is None:
+        on_mismatch = envvars.get("DETPU_ON_MISMATCH")
 
     if is_chief is None:
         def _chief() -> bool:
@@ -257,11 +273,28 @@ def run_resilient(step_fn: Callable, state, data, *,
                 "run_resilient(resume=True) with an existing checkpoint "
                 "needs emb_optimizer= and dense_tx= to rebuild the state")
         runtime.fault_point("driver.resume")
+        # events are process-global: discard any reshard recorded by an
+        # earlier unrelated restore so the drain below sees only OURS
+        obs.drain_events("checkpoint_reshard")
         state = restore_train_state(
             checkpoint_dir, de, emb_optimizer, state.dense_params,
-            dense_tx, mesh=mesh)
+            dense_tx, mesh=mesh, on_mismatch=on_mismatch)
         logger.info("run_resilient: resumed at step %d from %s",
                     int(state.step), checkpoint_dir)
+        for ev in obs.drain_events("checkpoint_reshard"):
+            # degraded elastic resume: surface it loudly and durably —
+            # the run continues, but capacity/placement changed underneath
+            diff = ev.get("diff", {})
+            logger.warning(
+                "run_resilient: resumed onto a DIFFERENT topology (world "
+                "%s -> %s, strategy %s -> %s, per-rank byte deltas %s) — "
+                "re-sharded in place, continuing degraded",
+                *diff.get("world_size", [None, None]),
+                *diff.get("strategy", [None, None]),
+                diff.get("per_rank_byte_deltas"))
+            if metrics_logger is not None and _chief():
+                metrics_logger.log_event(
+                    "checkpoint_reshard", step=int(state.step), diff=diff)
         if telemetry_state is not None and telemetry_path is not None \
                 and os.path.isfile(telemetry_path + ".state.npz"):
             from ..analysis import telemetry as tel

@@ -19,6 +19,7 @@ Planned artifacts (names kept aligned with the reference for parity auditing):
 
 from __future__ import annotations
 
+import json
 from collections import Counter, defaultdict
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -26,7 +27,7 @@ from typing import Any, Dict, List, Optional, Sequence
 Config = Dict[str, Any]
 
 _STRATEGIES = ("basic", "memory_balanced", "memory_optimized",
-               "comm_balanced")
+               "comm_balanced", "telemetry_balanced")
 
 
 def _table_elements(config: Config) -> int:
@@ -97,7 +98,8 @@ def maybe_slice_table_row(orig_config: Config,
 def apply_strategy(mode: str, world_size: int,
                    sliced_configs: List[List[Config]],
                    input_table_map: Optional[Sequence[int]] = None,
-                   input_hotness: Optional[Sequence[int]] = None
+                   input_hotness: Optional[Sequence[int]] = None,
+                   table_loads: Optional[Sequence[float]] = None
                    ) -> List[List[int]]:
     """Assign sliced tables to ranks; returns per-rank lists of global table ids
     (reference ``dist_model_parallel.py:160-196``).
@@ -118,6 +120,16 @@ def apply_strategy(mode: str, world_size: int,
       on the rank that minimally grows the total padded exchange width
       ``sum_g w_g * max_r n_{g,r}``, tie-broken by byte load. Directly
       minimizes the executor's padding objective while keeping bytes close.
+    * ``telemetry_balanced``: balances MEASURED per-table traffic
+      (``table_loads``, e.g. from
+      :func:`...analysis.telemetry.table_loads_from_summary`) instead of
+      bytes — the feedback half of the telemetry observatory (ROADMAP
+      item 2b). Slices are placed greedily, heaviest measured load first,
+      on the least-loaded rank (ties broken by byte load, then rank id).
+      A table's load spreads evenly over its slices — exact for column
+      slices' bytes-per-id and the uniform-range approximation for row
+      slices (per-range traffic is not in the summary). Cold tables
+      (load 0) fall back to pure byte balancing via the tie-break.
     """
     flat_ids: List[int] = []
     flat_sizes: List[int] = []
@@ -193,7 +205,107 @@ def apply_strategy(mode: str, world_size: int,
                 n[(w, h)][best] += c
         return [[tid for _, tid in sorted(rank)] for rank in out]
 
+    if mode == "telemetry_balanced":
+        if table_loads is None:
+            raise ValueError(
+                "telemetry_balanced needs table_loads= (per-global-table "
+                "measured traffic, e.g. analysis.telemetry."
+                "table_loads_from_summary of a flushed telemetry summary)")
+        if len(table_loads) != len(sliced_configs):
+            raise ValueError(
+                f"table_loads has {len(table_loads)} entries but there are "
+                f"{len(sliced_configs)} tables (it is per-table)")
+        per_slice_load = [float(table_loads[tid]) / len(sliced_configs[tid])
+                          for tid in flat_ids]
+        # LPT on measured load; stable position index keeps ties
+        # deterministic across processes (every rank must plan identically)
+        order = sorted(range(len(flat_ids)),
+                       key=lambda i: (-per_slice_load[i], -flat_sizes[i], i))
+        loads = [0.0] * world_size
+        sizes = [0] * world_size
+        out = [[] for _ in range(world_size)]
+        for i in order:
+            r = min(range(world_size),
+                    key=lambda r: (loads[r], sizes[r], r))
+            out[r].append((i, flat_ids[i]))
+            loads[r] += per_slice_load[i]
+            sizes[r] += flat_sizes[i]
+        return [[tid for _, tid in sorted(rank)] for rank in out]
+
     raise ValueError(f"Unsupported strategy {mode}")
+
+
+# ------------------------------------------------------- plan fingerprints
+
+
+#: plan_spec keys that determine the physical layout of checkpointed state.
+#: Two plans whose material keys match restore identically regardless of
+#: the strategy LABEL that produced them (e.g. a basic and a
+#: memory_balanced plan that happen to agree).
+_MATERIAL_PLAN_KEYS = ("world_size", "table_ids_list", "local_tables")
+
+
+def _canon(x):
+    """JSON-normalize (tuples -> lists, numpy ints -> ints) so specs read
+    back from a ``meta.json`` compare equal to freshly computed ones."""
+    return json.loads(json.dumps(x))
+
+
+def plans_equal(a: Optional[Dict[str, Any]],
+                b: Optional[Dict[str, Any]]) -> bool:
+    """Material equality of two :meth:`DistEmbeddingStrategy.plan_spec`
+    dicts: same world size, same rank->tables assignment, same per-rank
+    slice geometry. The strategy *name* and thresholds are advisory (they
+    describe how the plan was derived, not what it is)."""
+    if a is None or b is None:
+        return False
+    return all(_canon(a.get(k)) == _canon(b.get(k))
+               for k in _MATERIAL_PLAN_KEYS)
+
+
+def plan_diff(old: Optional[Dict[str, Any]], new: Dict[str, Any],
+              param_bytes: int = 4) -> Dict[str, Any]:
+    """Structured diff of two plan specs — what the re-shard dry run
+    prints and what the degradation log records on an elastic resume.
+
+    Returns world sizes, strategy labels, per-rank byte loads under both
+    plans (``param_bytes`` per table element; pass 2 for bf16 tables),
+    per-rank deltas over the common ranks, and the tables whose owning
+    rank set changed. ``old`` may be ``None`` (pre-plan-manifest
+    checkpoint): the old half is then reported as unknown."""
+    def rank_bytes(spec):
+        if spec is None or "per_rank_elements" not in spec:
+            return None
+        return [int(e) * param_bytes for e in spec["per_rank_elements"]]
+
+    def owners(spec):
+        if spec is None:
+            return {}
+        own: Dict[int, List[int]] = {}
+        for r, tids in enumerate(spec.get("table_ids_list", [])):
+            for tid in tids:
+                own.setdefault(int(tid), []).append(r)
+        return own
+
+    old_b, new_b = rank_bytes(old), rank_bytes(new)
+    deltas = None
+    if old_b is not None and new_b is not None:
+        deltas = [new_b[r] - old_b[r]
+                  for r in range(min(len(old_b), len(new_b)))]
+    old_own, new_own = owners(old), owners(new)
+    moved = sorted(t for t in new_own
+                   if old_own and old_own.get(t) != new_own[t])
+    return {
+        "equal": plans_equal(old, new),
+        "world_size": [old.get("world_size") if old else None,
+                       new.get("world_size")],
+        "strategy": [old.get("strategy") if old else None,
+                     new.get("strategy")],
+        "per_rank_bytes_old": old_b,
+        "per_rank_bytes_new": new_b,
+        "per_rank_byte_deltas": deltas,
+        "moved_tables": moved,
+    }
 
 
 class DistEmbeddingStrategy:
@@ -211,6 +323,10 @@ class DistEmbeddingStrategy:
       input_hotness: optional per-input hotness hint used only by the
         ``comm_balanced`` strategy to model the executor's (width, hotness)
         exchange groups exactly; placement stays valid without it.
+      table_loads: per-global-table measured traffic weights, required by
+        (and only used by) the ``telemetry_balanced`` strategy — feed it
+        :func:`...analysis.telemetry.table_loads_from_summary` of a
+        flushed telemetry summary.
     """
 
     def __init__(self,
@@ -220,13 +336,16 @@ class DistEmbeddingStrategy:
                  input_table_map: Optional[Sequence[int]] = None,
                  column_slice_threshold: Optional[int] = None,
                  input_hotness: Optional[Sequence[int]] = None,
-                 row_slice_threshold: Optional[int] = None):
+                 row_slice_threshold: Optional[int] = None,
+                 table_loads: Optional[Sequence[float]] = None):
         if strategy not in _STRATEGIES:
             raise ValueError(f"Unsupported shard strategy {strategy}")
         self.strategy = strategy
         self.world_size = world_size
         self.column_slice_threshold = column_slice_threshold
         self.row_slice_threshold = row_slice_threshold
+        self.table_loads = (None if table_loads is None
+                            else [float(x) for x in table_loads])
         self.global_configs = [
             c.get_config() if hasattr(c, "get_config") else dict(c)
             for c in configs]
@@ -241,6 +360,11 @@ class DistEmbeddingStrategy:
                 f"input_hotness has {len(input_hotness)} entries but there "
                 f"are {len(self.input_table_map)} inputs (it is per-input, "
                 "not per-table)")
+        if (self.table_loads is not None
+                and len(self.table_loads) != len(self.global_configs)):
+            raise ValueError(
+                f"table_loads has {len(self.table_loads)} entries but "
+                f"there are {len(self.global_configs)} tables")
 
         if world_size == 1:
             self.local_configs = self.global_configs
@@ -266,7 +390,8 @@ class DistEmbeddingStrategy:
         self.table_ids_list = apply_strategy(strategy, world_size,
                                              sliced_configs,
                                              self.input_table_map,
-                                             input_hotness)
+                                             input_hotness,
+                                             table_loads=self.table_loads)
 
         # Build the global routing view, consuming each table's slices in rank
         # order (reference dist_model_parallel.py:70-98).
@@ -345,6 +470,44 @@ class DistEmbeddingStrategy:
 
     def local_table_sizes(self, rank: int) -> int:
         return sum(_table_elements(c) for c in self.local_configs_list[rank])
+
+    def plan_spec(self) -> Dict[str, Any]:
+        """JSON-able fingerprint of this plan — recorded in every
+        checkpoint's ``meta.json`` so restore can tell "same layout" from
+        "needs a re-shard" (:func:`plans_equal`) and the re-shard tooling
+        can diff placements (:func:`plan_diff`).
+
+        ``local_tables[r]`` lists, per local table ``m``,
+        ``[table_id, rows, width, row_base, col_start]`` — the same slice
+        geometry the checkpoint codec routes by (column slices consumed
+        in rank order, row slices carrying their first global row)."""
+        col_pos = {tid: 0 for tid in range(len(self.global_configs))}
+        local_tables: List[List[List[int]]] = []
+        for r, cfgs in enumerate(self.local_configs_list):
+            rank_entries = []
+            for m, cfg in enumerate(cfgs):
+                tid = self.table_ids_list[r][m]
+                w = int(cfg["output_dim"])
+                if tid in self.row_sliced_tables:
+                    rank_entries.append(
+                        [tid, int(cfg["input_dim"]), w,
+                         int(cfg.get("_row_base", 0)), 0])
+                else:
+                    rank_entries.append(
+                        [tid, int(cfg["input_dim"]), w, 0, col_pos[tid]])
+                    col_pos[tid] += w
+            local_tables.append(rank_entries)
+        return {
+            "world_size": int(self.world_size),
+            "strategy": self.strategy,
+            "column_slice_threshold": self.column_slice_threshold,
+            "row_slice_threshold": self.row_slice_threshold,
+            "table_ids_list": [list(map(int, t))
+                               for t in self.table_ids_list],
+            "local_tables": local_tables,
+            "per_rank_elements": [self.local_table_sizes(r)
+                                  for r in range(self.world_size)],
+        }
 
     @property
     def num_inputs(self) -> int:

@@ -3,9 +3,9 @@ layer, and the step-level observability layer for the example models and
 entry points."""
 
 from . import obs, runtime
-from .checkpoint import (previous_checkpoint_path, restore_train_state,
-                         save_train_state, validate_checkpoint_model,
-                         verify_checkpoint)
+from .checkpoint import (previous_checkpoint_path, reshard_checkpoint,
+                         restore_train_state, save_train_state,
+                         validate_checkpoint_model, verify_checkpoint)
 from .data import DummyDataset, RawBinaryDataset, fast_forward, power_law_ids
 from .metrics import binary_auc
 from .obs import (MetricsLogger, StepTimer, counter_inc, counters,

@@ -49,8 +49,26 @@ torn file on disk are normal operating conditions, not fatal errors.
   checkpoint at ``<path>.prev``; :func:`restore_train_state` falls back to
   it (with a clear log line) instead of loading torn state.
 * ``DETPU_FAULT=die:checkpoint_write`` kills the process inside the write
-  path, so the whole story is testable on CPU (see
-  ``tests/test_checkpoint_atomic.py``).
+  path, and ``DETPU_FAULT=corrupt@ckpt`` flips bytes in a just-committed
+  shard file (silent bit rot the CRC manifest must catch), so the whole
+  story is testable on CPU (see ``tests/test_checkpoint_atomic.py``).
+
+Elastic topology (the logical-table codec): every array in a checkpoint is
+a **full logical table** — ``save_train_state`` reassembles each table
+(params and every slab-shaped optimizer component) from its slices via the
+strategy's row-offset/column-slice metadata before writing, and restore
+re-slices it under the restoring model's plan through the streaming
+``set_weights``. The on-disk format therefore carries NO sharding: a
+checkpoint written on a v5e-16 under ``memory_balanced`` restores on 8
+chips under a ``telemetry_balanced`` plan, table by table, with peak host
+memory one table. ``meta.json`` records the *plan fingerprint*
+(``DistEmbeddingStrategy.plan_spec``) purely so restore can TELL the
+topologies apart: ``restore_train_state(on_mismatch=...)`` either raises a
+named :class:`~.runtime.CheckpointMismatch` (``"error"``) or re-shards in
+place (``"reshard"``), logging the degradation (old plan, new plan,
+per-rank byte deltas) through :mod:`.obs`. :func:`reshard_checkpoint` is
+the offline half — it rewrites a checkpoint to a new plan/world size
+without touching a device (``tools/reshard.py`` is the CLI).
 """
 
 from __future__ import annotations
@@ -151,6 +169,42 @@ def previous_checkpoint_path(path: str) -> str:
     return path.rstrip(os.sep) + ".prev"
 
 
+def _commit_staging(staging: str, path: str,
+                    keep_previous: bool = True) -> None:
+    """Swap a fully written staging directory into ``path`` (one directory
+    rename; the displaced valid checkpoint survives at ``<path>.prev``
+    when ``keep_previous``), then honor a ``DETPU_FAULT=corrupt@ckpt``
+    drill by flipping bytes mid-file in the committed checkpoint's first
+    table shard — AFTER the commit, so the manifest certifies a file the
+    disk then silently diverges from (the scenario CRC validation
+    exists for)."""
+    runtime.fault_point("checkpoint_commit")
+    prev = previous_checkpoint_path(path)
+    if os.path.isdir(path):
+        if keep_previous and os.path.isfile(
+                os.path.join(path, "meta.json")):
+            if os.path.isdir(prev):
+                shutil.rmtree(prev)
+            os.replace(path, prev)
+        else:  # invalid leftovers (or fallback disabled): drop them
+            shutil.rmtree(path)
+    os.replace(staging, path)
+    _fsync_dir(os.path.dirname(os.path.abspath(path)))
+    if runtime.corrupt_ckpt_requested():
+        target = os.path.join(path, "tables", "table_000.npy")
+        if os.path.isfile(target):
+            with open(target, "r+b") as f:
+                f.seek(max(0, os.path.getsize(target) // 2))
+                byte = f.read(1) or b"\x00"
+                f.seek(-len(byte), os.SEEK_CUR)
+                f.write(bytes([byte[0] ^ 0xFF]))
+            logger.error("DETPU_FAULT=corrupt@ckpt: flipped a byte in %s",
+                         target)
+            from . import obs  # lazy: obs is jax-free but keep parity with
+            # runtime's own lazy pattern
+            obs.record_fault("ckpt_corrupt")
+
+
 def _staging_path(path: str) -> str:
     return path.rstrip(os.sep) + ".staging"
 
@@ -226,6 +280,54 @@ def validate_checkpoint_model(path: str, meta: Dict[str, Any], de) -> None:
                 f"table {t}: checkpoint at {path!r} was saved with "
                 f"vocab x dim {got}, the model expects {exp} — fix the "
                 "embedding configs or point at the matching checkpoint")
+
+
+def _plan_tools():
+    """Lazy import of the plan-fingerprint helpers. Function-local for the
+    same reason ``parallel.trainer`` is (module docstring): a module-scope
+    ``..parallel`` import from here would close an import cycle while
+    ``utils`` is mid-initialization."""
+    from ..parallel.strategy import plan_diff, plans_equal
+
+    return plans_equal, plan_diff
+
+
+def _check_plan(path: str, meta: Dict[str, Any], de,
+                on_mismatch: str) -> bool:
+    """Compare the checkpoint's recorded plan fingerprint against ``de``'s.
+    Returns True when they differ and ``on_mismatch='reshard'`` authorizes
+    re-slicing (the degradation is recorded through ``obs.record_event``
+    and a warning log); raises :class:`~.runtime.CheckpointMismatch` under
+    ``'error'``. Pre-manifest checkpoints (no recorded plan) compare as
+    matching — there is nothing to diff."""
+    saved = meta.get("plan")
+    if saved is None:
+        return False
+    plans_equal, plan_diff = _plan_tools()
+    current = de.strategy.plan_spec()
+    if plans_equal(saved, current):
+        return False
+    param_bytes = jnp.dtype(
+        meta.get("dtypes", {}).get("tables", "float32")).itemsize
+    diff = plan_diff(saved, current, param_bytes=param_bytes)
+    desc = (f"world {diff['world_size'][0]} -> {diff['world_size'][1]}, "
+            f"strategy {diff['strategy'][0]!r} -> {diff['strategy'][1]!r}, "
+            f"{len(diff['moved_tables'])} table(s) change ranks")
+    if on_mismatch != "reshard":
+        raise runtime.CheckpointMismatch(
+            f"checkpoint at {path!r} was written under a different "
+            f"sharding plan ({desc}). Pass on_mismatch='reshard' to "
+            "re-slice it under the current plan on the fly, or rewrite it "
+            "offline with tools/reshard.py")
+    from . import obs
+
+    obs.record_event("checkpoint_reshard", path=path,
+                     old_plan=saved, new_plan=current, diff=diff)
+    logger.warning(
+        "restore_train_state: re-sharding checkpoint %s onto a different "
+        "topology (%s; per-rank byte deltas on common ranks: %s)",
+        path, desc, diff["per_rank_byte_deltas"])
+    return True
 
 
 def _is_slab_dict(tree, params) -> bool:
@@ -335,6 +437,11 @@ def save_train_state(path: str, de, state: HybridTrainState,
                 # of a scatter-shape traceback (CheckpointMismatch)
                 "tables": [[int(c["input_dim"]), int(c["output_dim"])]
                            for c in de.strategy.global_configs],
+                # the sharding-plan fingerprint: the DATA is plan-agnostic
+                # (full logical tables); this records which topology wrote
+                # it so restore can tell "same layout" from "needs a
+                # re-shard" and diff the two (strategy.plan_diff)
+                "plan": de.strategy.plan_spec(),
                 "slab_components": sorted(slabs),
                 "aux_components": sorted(aux),
                 # per-component saved dtypes: a bf16-tables + fp32-accumulator
@@ -350,23 +457,53 @@ def save_train_state(path: str, de, state: HybridTrainState,
                      lambda f: f.write(json.dumps(meta).encode()))
         _fsync_dir(staging)
         # ---- commit: one directory swap; old checkpoint -> <path>.prev
-        runtime.fault_point("checkpoint_commit")
-        prev = previous_checkpoint_path(path)
-        if os.path.isdir(path):
-            if keep_previous and os.path.isfile(
-                    os.path.join(path, "meta.json")):
-                if os.path.isdir(prev):
-                    shutil.rmtree(prev)
-                os.replace(path, prev)
-            else:  # invalid leftovers (or fallback disabled): drop them
-                shutil.rmtree(path)
-        os.replace(staging, path)
-        _fsync_dir(os.path.dirname(os.path.abspath(path)))
+        _commit_staging(staging, path, keep_previous=keep_previous)
+
+
+def _aux_consensus(comp: Dict[str, Any]) -> float:
+    """Collapse a saved aux component (per-width-slab counter arrays) to
+    its single representative value. The only aux leaves the optimizer
+    zoo produces are per-slab step counters (SparseAdam), which advance
+    in lockstep across slabs — take the max and warn if they ever
+    disagree (max keeps Adam's bias correction conservative)."""
+    flat = [np.asarray(v).reshape(-1) for v in comp.values()]
+    allv = np.concatenate(flat) if flat else np.zeros((1,))
+    top = float(allv.max()) if allv.size else 0.0
+    if allv.size and not np.all(allv == top):
+        logger.warning(
+            "aux optimizer component: per-slab values disagree (min %s, "
+            "max %s) across the re-shard; using the max", allv.min(), top)
+    return top
+
+
+def _adapt_aux(name: str, comp: Dict[str, Any], wkey: str, spec,
+               resharding: bool):
+    """Restore one aux optimizer leaf (``emb_opt/<name>.npz`` entry
+    ``wkey``). Same-plan restores reproduce the saved array exactly; a
+    re-shard rebuilds the leaf at the NEW width/world geometry from the
+    saved per-slab consensus (a new width group or changed world size has
+    no saved twin to reshape from)."""
+    arr = comp.get(wkey)
+    if arr is not None:
+        arr = np.asarray(arr)
+        if arr.size == int(np.prod(spec.shape)):
+            return jnp.asarray(arr).reshape(spec.shape).astype(spec.dtype)
+        if not resharding:
+            raise runtime.CheckpointMismatch(
+                f"aux optimizer component {name}/{wkey}: saved shape "
+                f"{arr.shape} cannot fill {spec.shape} and the checkpoint "
+                "plan matches the model — corrupt aux component?")
+    elif not resharding:
+        raise runtime.CheckpointMismatch(
+            f"aux optimizer component {name} is missing width key "
+            f"{wkey!r} though the checkpoint plan matches the model")
+    return jnp.full(spec.shape, _aux_consensus(comp), spec.dtype)
 
 
 def restore_train_state(path: str, de, emb_optimizer, dense_template,
                         dense_tx, mesh=None, dtype=None,
-                        fallback: bool = True) -> HybridTrainState:
+                        fallback: bool = True,
+                        on_mismatch: str = "error") -> HybridTrainState:
     """Rebuild a :class:`HybridTrainState` from :func:`save_train_state`
     output. ``dense_template`` supplies the dense params/opt pytree
     structure (e.g. a freshly initialized state's ``dense_params``);
@@ -379,11 +516,32 @@ def restore_train_state(path: str, de, emb_optimizer, dense_template,
     component name (``"tables"``, ``"state"``, ``"state0"``, ...) for
     per-component overrides (missing keys keep their saved dtype).
 
+    ``on_mismatch``: what to do when the checkpoint's recorded sharding
+    plan (world size / placement / slicing) differs from ``de``'s:
+
+    * ``"error"`` (default): raise :class:`~.runtime.CheckpointMismatch`
+      naming both topologies — restoring onto a different mesh is an
+      operator decision, not something to do silently.
+    * ``"reshard"``: re-slice every logical table (params + slab-shaped
+      optimizer state) under ``de``'s plan while streaming it in, adapt
+      the per-slab optimizer aux leaves (Adam step counts) to the new
+      width/world geometry, and record the degradation — old plan, new
+      plan, per-rank byte deltas — through
+      :func:`~.obs.record_event` (``"checkpoint_reshard"``) plus a
+      warning log. This is the elastic-resume path
+      (``parallel.resilient.run_resilient`` defaults to it).
+
+    Checkpoints written before plan manifests existed restore as before
+    (nothing to compare against).
+
     Validation: the checkpoint is CRC-verified against its manifest before
     anything loads. A torn checkpoint is never restored — with ``fallback``
     (the default) the previous valid checkpoint at ``<path>.prev`` is
     restored instead (clear warning logged); otherwise
     :class:`~.runtime.CheckpointCorrupt` propagates."""
+    if on_mismatch not in ("error", "reshard"):
+        raise ValueError(
+            f"on_mismatch must be 'error' | 'reshard', got {on_mismatch!r}")
     runtime.fault_point("checkpoint_read")
     try:
         meta = verify_checkpoint(path)
@@ -399,6 +557,7 @@ def restore_train_state(path: str, de, emb_optimizer, dense_template,
     # structural match BEFORE any data streams: a mismatched-but-whole
     # checkpoint is a config error, not corruption — no .prev fallback
     validate_checkpoint_model(path, meta, de)
+    resharding = _check_plan(path, meta, de, on_mismatch)
     n = meta["num_tables"]
     saved_dtypes = meta.get("dtypes", {})
 
@@ -452,8 +611,8 @@ def restore_train_state(path: str, de, emb_optimizer, dense_template,
                     parts.append(slab_comps[name][k])
                 else:
                     spec = opt_struct[k][i]
-                    parts.append(jnp.asarray(aux_comps[name][k])
-                                 .reshape(spec.shape).astype(spec.dtype))
+                    parts.append(_adapt_aux(name, aux_comps[name], k,
+                                            spec, resharding))
             new[k] = tuple(parts)
         opt_state = new
     else:
@@ -478,3 +637,137 @@ def restore_train_state(path: str, de, emb_optimizer, dense_template,
         dense_params=dense["dense_params"],
         dense_opt_state=dense["dense_opt_state"],
         step=jnp.asarray(dense["step"]))
+
+
+# --------------------------------------------------- offline re-shard codec
+
+
+def _copy_file(src: str, dst: str, chunk_bytes: int = 1 << 20) -> None:
+    """Streamed copy + fsync (constant memory; tables can be GBs)."""
+    with open(src, "rb") as fin, open(dst, "wb") as fout:
+        shutil.copyfileobj(fin, fout, chunk_bytes)
+        fout.flush()
+        os.fsync(fout.fileno())
+
+
+def reshard_checkpoint(src: str, dst: str, target,
+                       dry_run: bool = False) -> Dict[str, Any]:
+    """Rewrite the checkpoint at ``src`` to ``dst`` under ``target``'s
+    sharding plan — entirely host-side (no device, no jax arrays): the
+    on-disk data is full logical tables, so re-sharding copies them
+    byte-identically (streamed file by file; peak memory one copy chunk)
+    and rewrites only the plan-dependent pieces — the ``meta.json`` plan
+    fingerprint and the per-slab optimizer aux leaves (Adam step counts),
+    which are rebuilt at the target's width/world geometry from the saved
+    consensus. ``dst`` then restores cleanly (no ``on_mismatch`` needed)
+    into a model using the target plan, and a round trip back to the
+    original plan reproduces every array bit for bit.
+
+    Args:
+      src: source checkpoint directory (CRC-verified before anything is
+        read; must carry a ``files`` manifest — pre-CRC-era checkpoints
+        must be re-saved first).
+      dst: destination directory (atomic staging + swap, like
+        :func:`save_train_state`; an existing valid checkpoint there is
+        kept at ``<dst>.prev``). Must differ from ``src``.
+      target: the topology to re-shard to — a
+        :class:`~..parallel.strategy.DistEmbeddingStrategy` or anything
+        carrying one as ``.strategy`` (a ``DistributedEmbedding``). Its
+        global table shapes must match the checkpoint's.
+      dry_run: diff only — nothing is written.
+
+    Returns:
+      The :func:`~..parallel.strategy.plan_diff` dict (old plan vs target
+      plan: world sizes, per-rank byte loads and deltas, moved tables).
+    """
+    strat = target if hasattr(target, "plan_spec") else target.strategy
+    if len(strat.global_configs) < int(strat.world_size):
+        # mirror DistributedEmbedding's fewer-tables-than-positions limit:
+        # the rewrite would succeed but no model could ever load it
+        raise ValueError(
+            f"target plan has {int(strat.world_size)} ranks but only "
+            f"{len(strat.global_configs)} table(s) — fewer tables than "
+            "mesh positions is unsupported, so the re-sharded checkpoint "
+            "could never be restored")
+    meta = verify_checkpoint(src)
+    if meta.get("files") is None:
+        raise runtime.CheckpointCorrupt(
+            f"checkpoint at {src!r} predates CRC/plan manifests — re-save "
+            "it with the current code before re-sharding")
+    # the target must describe the SAME logical model
+    saved_tables = meta.get("tables")
+    want = [[int(c["input_dim"]), int(c["output_dim"])]
+            for c in strat.global_configs]
+    if int(meta.get("num_tables", -1)) != len(want) or (
+            saved_tables is not None
+            and [list(map(int, t)) for t in saved_tables] != want):
+        raise runtime.CheckpointMismatch(
+            f"target plan declares tables {want} but the checkpoint at "
+            f"{src!r} holds {meta.get('num_tables')} table(s) "
+            f"{saved_tables} — re-sharding changes the topology, never "
+            "the model")
+    _, plan_diff = _plan_tools()
+    new_plan = strat.plan_spec()
+    param_bytes = jnp.dtype(
+        meta.get("dtypes", {}).get("tables", "float32")).itemsize
+    diff = plan_diff(meta.get("plan"), new_plan, param_bytes=param_bytes)
+    if dry_run:
+        return diff
+    if os.path.abspath(src) == os.path.abspath(dst):
+        raise ValueError(
+            "reshard_checkpoint: src and dst must differ (the staging swap "
+            "would otherwise displace the source mid-copy)")
+
+    new_world = int(strat.world_size)
+    new_widths = sorted({int(c["output_dim"])
+                         for cfgs in strat.local_configs_list
+                         for c in cfgs})
+    aux_files = {}
+    for name in meta.get("aux_components", []):
+        aux_files[f"emb_opt/{name}.npz"] = name
+        aux_files[f"emb_opt/{name}.npy"] = name  # pre-r5 stacked format
+
+    staging = _staging_path(dst)
+    if os.path.isdir(staging):  # leftover of an earlier killed reshard
+        shutil.rmtree(staging)
+    manifest: Dict[str, int] = {}
+    for rel, crc in meta["files"].items():
+        out = os.path.join(staging, rel)
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        name = aux_files.get(rel)
+        if name is None:
+            # logical-table data (and the replicated dense state) is
+            # plan-agnostic: byte-identical streamed copy, CRC carried
+            # over from the just-verified source manifest
+            _copy_file(os.path.join(src, rel), out)
+            manifest[rel] = crc
+            continue
+        if rel.endswith(".npy"):  # pre-r5 stacked rows -> per-wkey dict
+            rows = np.load(os.path.join(src, rel))
+            comp = {k: rows[i]
+                    for i, k in enumerate(meta["aux_wkey_order"])}
+            rel = rel[:-len(".npy")] + ".npz"  # rewrite in the npz format
+            out = os.path.join(staging, rel)
+        else:
+            with np.load(os.path.join(src, rel)) as loaded:
+                comp = {k: loaded[k] for k in loaded.files}
+        value = _aux_consensus(comp)
+        tail = (np.asarray(next(iter(comp.values()))).shape[1:]
+                if comp else (1, 1))
+        dt = (np.asarray(next(iter(comp.values()))).dtype
+              if comp else np.float32)
+        rebuilt = {f"w{w}": np.full((new_world,) + tuple(tail), value, dt)
+                   for w in new_widths}
+        manifest[rel] = _atomic_file(
+            out, lambda f, c=rebuilt: np.savez(f, **c))
+    meta_new = dict(meta, plan=new_plan, files=manifest)
+    _atomic_file(os.path.join(staging, "meta.json"),
+                 lambda f: f.write(json.dumps(meta_new).encode()))
+    _fsync_dir(staging)
+    _commit_staging(staging, dst, keep_previous=True)
+    logger.info(
+        "reshard_checkpoint: %s -> %s (world %s -> %s, strategy %s -> %s, "
+        "%d table(s) moved ranks)", src, dst, diff["world_size"][0],
+        diff["world_size"][1], diff["strategy"][0], diff["strategy"][1],
+        len(diff["moved_tables"]))
+    return diff

@@ -151,8 +151,16 @@ declare("DETPU_NANGUARD_K", default="3",
 
 # fault injection + runtime probes (utils/runtime.py)
 declare("DETPU_FAULT", default="",
-        doc="comma-separated fault injections: hang|slow|raise|die:<point> "
-            "or preempt@<step>")
+        doc="comma-separated fault injections: hang|slow|raise|die:<point>, "
+            "preempt@<step> (driver self-SIGTERM drill), or corrupt@ckpt "
+            "(flip bytes in each just-committed checkpoint shard so the "
+            "CRC manifest + .prev fallback are exercisable end to end)")
+declare("DETPU_ON_MISMATCH", default="reshard",
+        doc="resilient-driver restore policy when a checkpoint's recorded "
+            "sharding plan/world size differs from the model's: 'reshard' "
+            "= re-slice the logical tables under the current plan and "
+            "continue (elastic resume; degradation logged), 'error' = "
+            "raise CheckpointMismatch (the strict pre-elastic behavior)")
 declare("DETPU_PROBE_TIMEOUT_S", default="120",
         doc="time box (seconds) for the subprocess backend probe")
 declare("DETPU_DRYRUN_TIMEOUT_S", default="600",

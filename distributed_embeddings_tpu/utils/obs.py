@@ -45,7 +45,7 @@ import logging
 import os
 import threading
 import time
-from typing import Any, Dict, Iterator, Optional
+from typing import Any, Dict, Iterator, List, Optional
 
 from . import envvars
 from . import runtime as _runtime
@@ -187,6 +187,39 @@ def reset_counters() -> None:
         _counters.clear()
 
 
+# ---------------------------------------------------------- process events
+
+# Structured one-shot events (e.g. a checkpoint re-shard on elastic
+# resume): producers deep in library code record them here; the driver
+# layer drains and routes them to its MetricsLogger / log output. Unlike
+# counters these carry a payload; like counters they are process-global
+# so a utils-level producer needs no logger plumbed through.
+_events: List[Dict[str, Any]] = []
+
+
+def record_event(kind: str, **payload: Any) -> Dict[str, Any]:
+    """Record one structured event (also bumps the ``event_<kind>``
+    counter); returns the stored record."""
+    rec = {"event": kind, "time": time.time(), **payload}
+    with _counters_lock:
+        _events.append(rec)
+    counter_inc(f"event_{kind}")
+    return rec
+
+
+def drain_events(kind: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Pop (and return) recorded events — all of them, or only ``kind``.
+    Draining is the consumer's acknowledgment; events are delivered at
+    most once."""
+    with _counters_lock:
+        if kind is None:
+            out, _events[:] = list(_events), []
+            return out
+        out = [e for e in _events if e["event"] == kind]
+        _events[:] = [e for e in _events if e["event"] != kind]
+        return out
+
+
 _compile_listener_installed = False
 
 # one backend compile per jitted-signature miss: cache hits do not fire it
@@ -287,6 +320,13 @@ class MetricsLogger:
         """Append the current process-counter snapshot."""
         self._maybe_rotate()
         return self._rec.record("counters", counters=counters(), **extra)
+
+    def log_event(self, event: str, **fields: Any) -> Dict[str, Any]:
+        """Append one structured one-shot record (e.g. a
+        ``checkpoint_reshard`` degradation on elastic resume) under its
+        own section name."""
+        self._maybe_rotate()
+        return self._rec.record(event, **fields)
 
     @staticmethod
     def load(path: str):

@@ -140,8 +140,9 @@ def _fault_specs() -> List[Tuple[str, str, Optional[str]]]:
         item = item.strip()
         if not item:
             continue
-        if item.startswith("preempt@"):
-            continue  # driver-level preemption drill: see preempt_step()
+        if item.startswith("preempt@") or item == "corrupt@ckpt":
+            continue  # driver/checkpoint-level drills: see preempt_step()
+            # and corrupt_ckpt_requested()
         parts = item.split(":", 2)
         if len(parts) < 2:
             logger.warning("ignoring malformed %s entry %r", FAULT_ENV, item)
@@ -166,6 +167,17 @@ def preempt_step() -> Optional[int]:
         except ValueError:
             logger.warning("ignoring malformed %s entry %r", FAULT_ENV, item)
     return None
+
+
+def corrupt_ckpt_requested() -> bool:
+    """True when ``DETPU_FAULT=corrupt@ckpt`` asks the checkpoint layer to
+    flip bytes in a just-committed shard file — simulated silent on-disk
+    corruption (bit rot, torn external copy) that the CRC manifest must
+    catch on the next restore. Parsed per call like the other fault specs,
+    so tests can flip it at runtime and corrupt exactly the save they
+    choreograph."""
+    return any(item.strip() == "corrupt@ckpt"
+               for item in (envvars.get(FAULT_ENV) or "").split(","))
 
 
 def fault_point(point: str) -> None:

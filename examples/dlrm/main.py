@@ -197,9 +197,14 @@ def main(_):
         return bce_with_logits(dense.apply(dp, n, emb_outs), y)
 
     if FLAGS.restore_state:
-        from distributed_embeddings_tpu.utils import restore_train_state
-        state = restore_train_state(FLAGS.restore_state, de, emb_opt,
-                                    dense_params, tx, mesh=mesh)
+        from distributed_embeddings_tpu.utils import (envvars,
+                                                      restore_train_state)
+        state = restore_train_state(
+            FLAGS.restore_state, de, emb_opt, dense_params, tx, mesh=mesh,
+            # elastic by default, like run_resilient: a checkpoint from a
+            # different world size/plan re-shards in place (DETPU_ON_
+            # MISMATCH=error restores the strict behavior)
+            on_mismatch=envvars.get("DETPU_ON_MISMATCH"))
         if is_chief:
             print("restored train state at step", int(state.step),
                   "from", FLAGS.restore_state)

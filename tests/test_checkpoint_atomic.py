@@ -97,6 +97,92 @@ def test_torn_checkpoint_falls_back_to_previous_valid(tmp_path, caplog):
     assert int(restored.step) == int(state.step)
 
 
+def test_corrupt_ckpt_fault_is_caught_by_manifest(tmp_path, monkeypatch):
+    """DETPU_FAULT=corrupt@ckpt flips bytes in a just-COMMITTED shard
+    file — the manifest was written from the pristine bytes, so CRC
+    validation must catch the divergence, and restore must fall back to
+    the previous valid checkpoint."""
+    de, emb_opt, dp, tx, state = _tiny()
+    path = str(tmp_path / "ckpt")
+    save_train_state(path, de, state)  # v1, clean
+    v1_tables = [np.asarray(t) for t in de.get_weights(state.emb_params)]
+    monkeypatch.setenv("DETPU_FAULT", "corrupt@ckpt")
+    save_train_state(path, de, _bump(state))  # v2, corrupted post-commit
+    monkeypatch.delenv("DETPU_FAULT")
+    with pytest.raises(runtime.CheckpointCorrupt, match="CRC mismatch"):
+        verify_checkpoint(path)
+    restored = restore_train_state(path, de, emb_opt, dp, tx)  # .prev
+    got = [np.asarray(t) for t in de.get_weights(restored.emb_params)]
+    for a, b in zip(got, v1_tables):
+        np.testing.assert_array_equal(a, b)
+    assert int(restored.step) == int(state.step)
+
+
+def test_driver_continues_past_corrupted_checkpoint(tmp_path, monkeypatch):
+    """End-to-end: a resilient run whose LAST checkpoint was silently
+    corrupted on disk must, on restart, detect the corruption, fall back
+    to ``<path>.prev``, replay deterministically, and finish — no manual
+    intervention."""
+    import optax as _optax
+
+    from distributed_embeddings_tpu.parallel import run_resilient
+    from distributed_embeddings_tpu.parallel.trainer import (
+        make_hybrid_train_step)
+
+    de, emb_opt, dp, tx, state0 = _tiny()
+
+    def loss_fn(dparams, outs, batch):
+        x = sum(jnp.mean(o) for o in outs) * jnp.mean(dparams["w"])
+        return (x - jnp.mean(batch)) ** 2
+
+    step = make_hybrid_train_step(de, loss_fn, tx, emb_opt,
+                                  with_metrics=False)
+
+    def data(start):
+        for i in range(start, 8):
+            rng = np.random.default_rng(700 + i)
+            cats = [jnp.asarray(rng.integers(0, 12 + 3 * t, 8), jnp.int32)
+                    for t in range(3)]
+            yield cats, jnp.asarray(rng.normal(size=(8,)), jnp.float32)
+
+    ck = str(tmp_path / "ck")
+    common = dict(de=de, checkpoint_dir=ck, checkpoint_every_steps=2,
+                  resume=True, emb_optimizer=emb_opt, dense_tx=tx)
+    # leg 1 (clean): checkpoints at 2 and 4 -> ck@4, .prev@4-cadence
+    r1 = run_resilient(step, state0, data, until_step=4, **common)
+    assert r1.step == 4
+    # leg 2: the cadence save at step 6 lands corrupted on disk
+    # (save_on_exit off so exactly ONE save corrupts — the clean step-4
+    # checkpoint stays parked at .prev, as in a real bit-rot event)
+    monkeypatch.setenv("DETPU_FAULT", "corrupt@ckpt")
+    r2 = run_resilient(step, r1.state, data, until_step=6,
+                       save_on_exit=False, **common)
+    monkeypatch.delenv("DETPU_FAULT")
+    assert r2.step == 6
+    with pytest.raises(runtime.CheckpointCorrupt):
+        verify_checkpoint(ck)
+    # leg 3 (restart after the "bit rot"): falls back to .prev, replays,
+    # and completes the run
+    st3 = _fresh_state(de, emb_opt, tx)
+    r3 = run_resilient(step, st3, data, **common)
+    assert r3.step == 8 and not r3.preempted
+    verify_checkpoint(ck)  # the final save is whole again
+    # trajectory check: an uninterrupted run ends at the same state
+    ref = run_resilient(step, _fresh_state(de, emb_opt, tx), data, de=de)
+    got = de.get_weights(r3.state.emb_params)
+    want = de.get_weights(ref.state.emb_params)
+    for a, b in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def _fresh_state(de, emb_opt, tx):
+    # rebuilt from scratch (the step donates its inputs, so earlier legs'
+    # buffers are deleted); same init key as _tiny -> same initial state
+    return init_hybrid_state(de, emb_opt,
+                             {"w": jnp.ones((12, 1), jnp.float32)}, tx,
+                             jax.random.key(0))
+
+
 def test_save_is_atomic_under_injected_death(tmp_path):
     """DETPU_FAULT=die:checkpoint_write kills the child inside the second
     save's write path; the committed checkpoint must still be v1, whole."""

@@ -1,0 +1,417 @@
+"""Elastic topology resume: plan-aware checkpoint re-sharding
+(utils/checkpoint.py codec + tools/reshard.py + the resilient driver's
+mesh-shrink path) and the telemetry_balanced planner.
+
+The format invariant under test: checkpoints hold full LOGICAL tables, so
+a rewrite across world sizes (8->4, 4->8) and plan kinds (table-parallel
+<-> row-sliced <-> column-sliced) is byte-identical on the table data and
+A -> B -> A round-trips bit for bit — params AND sparse-optimizer state
+(SparseAdam exercises slab components plus the plan-dependent aux step
+counts).
+
+Also here: the cross-world-size SGD equivalence probe (ROADMAP item 1
+diagnostic) — the sparse path's 1/world mp-gradient scale convention must
+make world=1 and world=8 produce matching updates, or every elastic-resume
+equivalence claim is void.
+"""
+
+import filecmp
+import glob
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_embeddings_tpu.parallel import (
+    DistributedEmbedding, HybridTrainState, SparseAdam, SparseSGD,
+    init_hybrid_state, make_hybrid_train_step, run_resilient)
+from distributed_embeddings_tpu.parallel.strategy import (
+    DistEmbeddingStrategy, plans_equal)
+from distributed_embeddings_tpu.utils import (
+    obs, restore_train_state, runtime, save_train_state)
+from distributed_embeddings_tpu.utils.checkpoint import reshard_checkpoint
+from distributed_embeddings_tpu.analysis.telemetry import (
+    table_loads_from_summary)
+
+WORLD = 8
+B = 16
+CONFIGS = [{"input_dim": 20 + 4 * i, "output_dim": 4 if i % 2 else 16}
+           for i in range(8)]
+COLS = sum(c["output_dim"] for c in CONFIGS)
+
+
+def _data(seed):
+    rng = np.random.default_rng(seed)
+    cats = [jnp.asarray(rng.integers(0, c["input_dim"], size=(B,)),
+                        jnp.int32) for c in CONFIGS]
+    y = jnp.asarray(rng.normal(size=(B, 1)) * 0.1, jnp.float32)
+    return cats, y
+
+
+def _loss_fn(dp, emb_outs, batch):
+    x = jnp.concatenate([e.reshape(e.shape[0], -1) for e in emb_outs],
+                        axis=1)
+    return jnp.mean((x @ dp["w"] - batch) ** 2)
+
+
+def _dp():
+    return {"w": jnp.full((COLS, 1), 0.1, jnp.float32)}
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return Mesh(np.array(jax.devices()[:WORLD]), ("data",))
+
+
+@pytest.fixture(scope="module")
+def mesh4():
+    return Mesh(np.array(jax.devices()[:4]), ("data",))
+
+
+@pytest.fixture(scope="module")
+def de4():
+    return DistributedEmbedding(CONFIGS, world_size=4, strategy="basic")
+
+
+@pytest.fixture(scope="module")
+def step4(de4, mesh4):
+    return make_hybrid_train_step(de4, _loss_fn, optax.sgd(0.1),
+                                  SparseAdam(), mesh=mesh4,
+                                  lr_schedule=0.3, with_metrics=False)
+
+
+@pytest.fixture(scope="module")
+def ck8(tmp_path_factory, mesh8):
+    """A checkpoint written on the 8-rank topology after 2 Adam steps,
+    plus the logical tables it holds (the cross-plan ground truth)."""
+    de = DistributedEmbedding(CONFIGS, world_size=WORLD,
+                              strategy="memory_balanced")
+    emb_opt, tx = SparseAdam(), optax.sgd(0.1)
+    st = init_hybrid_state(de, emb_opt, _dp(), tx, jax.random.key(1),
+                           mesh=mesh8)
+    step = make_hybrid_train_step(de, _loss_fn, tx, emb_opt, mesh=mesh8,
+                                  lr_schedule=0.3, with_metrics=False)
+    cats, y = _data(0)
+    y8 = jax.device_put(y, NamedSharding(mesh8, P("data")))
+    for _ in range(2):
+        _, st = step(st, cats, y8)
+    path = str(tmp_path_factory.mktemp("elastic") / "ck8")
+    save_train_state(path, de, st)
+    tables = de.get_weights(st.emb_params)
+    return {"path": path, "de": de, "tables": tables,
+            "emb_opt": emb_opt, "tx": tx}
+
+
+def _tables_equal(a, b):
+    return all(np.array_equal(x, y) for x, y in zip(a, b))
+
+
+# ------------------------------------------------------ mismatch policies
+
+
+def test_plan_recorded_and_default_mismatch_raises(ck8, de4, mesh4):
+    with open(os.path.join(ck8["path"], "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["plan"]["world_size"] == WORLD
+    assert plans_equal(meta["plan"], ck8["de"].strategy.plan_spec())
+    with pytest.raises(runtime.CheckpointMismatch, match="reshard"):
+        restore_train_state(ck8["path"], de4, ck8["emb_opt"], _dp(),
+                            ck8["tx"], mesh=mesh4)
+
+
+def test_same_plan_restore_unaffected(ck8, mesh8):
+    """A matching topology restores under the strict default — the
+    elastic machinery must not tax the common path."""
+    de2 = DistributedEmbedding(CONFIGS, world_size=WORLD,
+                               strategy="memory_balanced")
+    st = restore_train_state(ck8["path"], de2, ck8["emb_opt"], _dp(),
+                             ck8["tx"], mesh=mesh8)
+    assert _tables_equal(ck8["tables"], de2.get_weights(st.emb_params))
+
+
+def test_online_reshard_8_to_4(ck8, de4, mesh4, step4):
+    obs.drain_events()  # isolate
+    st = restore_train_state(ck8["path"], de4, ck8["emb_opt"], _dp(),
+                             ck8["tx"], mesh=mesh4, on_mismatch="reshard")
+    assert int(st.step) == 2
+    assert _tables_equal(ck8["tables"], de4.get_weights(st.emb_params))
+    # degradation recorded: old plan, new plan, per-rank byte deltas
+    (ev,) = obs.drain_events("checkpoint_reshard")
+    assert ev["diff"]["world_size"] == [8, 4]
+    assert len(ev["diff"]["per_rank_byte_deltas"]) == 4
+    assert ev["old_plan"]["world_size"] == 8
+    assert ev["new_plan"]["world_size"] == 4
+    # the re-sharded optimizer state must be USABLE, not just shaped:
+    # one more Adam step on the shrunken mesh
+    cats, y = _data(0)
+    y4 = jax.device_put(y, NamedSharding(mesh4, P("data")))
+    _, st = step4(st, cats, y4)
+    assert int(st.step) == 3
+
+
+def test_online_reshard_to_column_sliced_rekeys_aux(ck8, mesh8):
+    """Column slicing changes the WIDTH SET (w16 tables split into w8
+    slices), so Adam's per-width aux step counts have no saved twin —
+    the codec rebuilds them from the saved consensus."""
+    de_cs = DistributedEmbedding(CONFIGS, world_size=WORLD,
+                                 strategy="basic",
+                                 column_slice_threshold=300)
+    assert de_cs.widths != ck8["de"].widths  # the premise
+    st = restore_train_state(ck8["path"], de_cs, ck8["emb_opt"], _dp(),
+                             ck8["tx"], mesh=mesh8, on_mismatch="reshard")
+    assert _tables_equal(ck8["tables"], de_cs.get_weights(st.emb_params))
+    for wkey, (_, _, count) in st.emb_opt_state.items():
+        np.testing.assert_array_equal(
+            np.asarray(count).reshape(-1), 2.0,
+            err_msg=f"Adam step count lost across re-key ({wkey})")
+    obs.drain_events("checkpoint_reshard")
+
+
+# ------------------------------------------------- offline codec round trip
+
+
+def test_offline_roundtrip_bitwise(ck8, tmp_path):
+    """A(8, memory_balanced) -> B(4, row-sliced) -> A'(original plan):
+    every table and optimizer-state array byte-identical, plan manifest
+    restored."""
+    ckb = str(tmp_path / "ckB")
+    cka2 = str(tmp_path / "ckA2")
+    target_b = DistEmbeddingStrategy(CONFIGS, 4, strategy="basic",
+                                     row_slice_threshold=120)
+    diff = reshard_checkpoint(ck8["path"], ckb, target_b)
+    assert diff["world_size"] == [8, 4]
+    reshard_checkpoint(ckb, cka2, ck8["de"])  # accepts a DistributedEmbedding
+    for f in sorted(glob.glob(os.path.join(ck8["path"], "tables", "*.npy"))
+                    + glob.glob(os.path.join(ck8["path"], "emb_opt", "*",
+                                             "*.npy"))
+                    + [os.path.join(ck8["path"], "dense.msgpack")]):
+        f2 = f.replace(ck8["path"], cka2)
+        assert filecmp.cmp(f, f2, shallow=False), f
+    with open(os.path.join(cka2, "meta.json")) as f:
+        meta2 = json.load(f)
+    assert plans_equal(meta2["plan"], ck8["de"].strategy.plan_spec())
+    # aux arrays (npz re-written, not byte-copied) equal at the array level
+    with np.load(os.path.join(ck8["path"], "emb_opt", "state2.npz")) as a, \
+            np.load(os.path.join(cka2, "emb_opt", "state2.npz")) as b:
+        assert sorted(a.files) == sorted(b.files)
+        for k in a.files:
+            np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_offline_reshard_restores_cleanly(ck8, de4, mesh4, tmp_path):
+    """4->8 grow: a checkpoint re-sharded offline restores under the
+    strict default policy (its plan now MATCHES), and the grow direction
+    reproduces the same logical tables."""
+    ck4 = str(tmp_path / "ck4")
+    reshard_checkpoint(ck8["path"], ck4,
+                       DistEmbeddingStrategy(CONFIGS, 4, strategy="basic"))
+    st = restore_train_state(ck4, de4, ck8["emb_opt"], _dp(), ck8["tx"],
+                             mesh=mesh4)  # on_mismatch default: no raise
+    assert _tables_equal(ck8["tables"], de4.get_weights(st.emb_params))
+
+
+def test_offline_dry_run_writes_nothing(ck8, tmp_path):
+    dst = str(tmp_path / "never")
+    diff = reshard_checkpoint(
+        ck8["path"], dst, DistEmbeddingStrategy(CONFIGS, 4),
+        dry_run=True)
+    assert not os.path.exists(dst)
+    assert diff["world_size"] == [8, 4]
+    assert diff["per_rank_bytes_new"] and diff["per_rank_byte_deltas"]
+
+
+def test_reshard_rejects_more_ranks_than_tables(ck8, tmp_path):
+    """A 16-rank plan over 8 tables would write a checkpoint no
+    DistributedEmbedding could ever load (fewer tables than mesh
+    positions is unsupported) — reject it up front."""
+    with pytest.raises(ValueError, match="fewer tables"):
+        reshard_checkpoint(ck8["path"], str(tmp_path / "x"),
+                           DistEmbeddingStrategy(CONFIGS, 16))
+
+
+def test_reshard_rejects_wrong_model(ck8, tmp_path):
+    other = [{"input_dim": 10, "output_dim": 4} for _ in range(3)]
+    with pytest.raises(runtime.CheckpointMismatch, match="never the model"):
+        reshard_checkpoint(ck8["path"], str(tmp_path / "x"),
+                           DistEmbeddingStrategy(other, 2))
+
+
+def test_reshard_cli(ck8, tmp_path, capsys):
+    from tools import reshard as cli
+
+    dst = str(tmp_path / "cli_out")
+    assert cli.main([ck8["path"], dst, "--world-size", "4",
+                     "--dry-run"]) == 0
+    assert not os.path.exists(dst)
+    assert cli.main([ck8["path"], dst, "--world-size", "4",
+                     "--strategy", "memory_balanced"]) == 0
+    out = capsys.readouterr().out
+    assert "world 8 -> 4" in out
+    assert os.path.isfile(os.path.join(dst, "meta.json"))
+    # corrupt source -> clean nonzero exit, no traceback
+    bad = str(tmp_path / "bad_src")
+    os.makedirs(bad)
+    assert cli.main([bad, str(tmp_path / "y"), "--world-size", "2"]) == 1
+
+
+# ------------------------------------------------ telemetry-driven plans
+
+
+def test_telemetry_balanced_planner_spreads_hot_tables():
+    loads = [1000.0, 1.0, 1.0, 990.0, 1.0, 1.0, 1.0, 980.0]
+    s = DistEmbeddingStrategy(CONFIGS, 4, strategy="telemetry_balanced",
+                              table_loads=loads)
+    per_rank = [sum(loads[t] for t in tids) for tids in s.table_ids_list]
+    imbalance = max(per_rank) / (sum(per_rank) / 4)
+    base = DistEmbeddingStrategy(CONFIGS, 4, strategy="basic")
+    base_rank = [sum(loads[t] for t in tids) for tids in base.table_ids_list]
+    base_imb = max(base_rank) / (sum(base_rank) / 4)
+    # the three hot tables land on three different ranks
+    owners = {r for r, tids in enumerate(s.table_ids_list)
+              for t in tids if t in (0, 3, 7)}
+    assert len(owners) == 3
+    assert imbalance < base_imb
+    with pytest.raises(ValueError, match="table_loads"):
+        DistEmbeddingStrategy(CONFIGS, 4, strategy="telemetry_balanced")
+
+
+def test_table_loads_from_summary_and_cli_feed(ck8, tmp_path, capsys):
+    summary = {"tables": [
+        {"table_id": 0, "top_rows": [[1, 500], [2, 300]]},
+        {"table_id": 5, "top_rows": [[0, 900]]},
+    ]}
+    loads = table_loads_from_summary(summary, len(CONFIGS))
+    assert loads[0] == 800.0 and loads[5] == 900.0
+    assert sum(loads) == 1700.0
+    tel = str(tmp_path / "tel.json")
+    with open(tel, "w") as f:
+        json.dump(summary, f)
+    from tools import reshard as cli
+
+    dst = str(tmp_path / "bal")
+    assert cli.main([ck8["path"], dst, "--world-size", "4",
+                     "--strategy", "telemetry_balanced",
+                     "--telemetry", tel]) == 0
+    with open(os.path.join(dst, "meta.json")) as f:
+        plan = json.load(f)["plan"]
+    assert plan["strategy"] == "telemetry_balanced"
+    # the two hot tables must sit on different ranks
+    owners = {r for r, tids in enumerate(plan["table_ids_list"])
+              for t in tids if t in (0, 5)}
+    assert len(owners) == 2
+    # missing summary is a usage error, not a stack trace
+    assert cli.main([ck8["path"], str(tmp_path / "z"), "--world-size", "4",
+                     "--strategy", "telemetry_balanced"]) == 2
+    capsys.readouterr()
+
+
+# --------------------------------------- cross-world trajectory equivalence
+
+
+def test_sgd_cross_world_equivalence(mesh8):
+    """ROADMAP item 1 diagnostic: the suspected 1/world mp-gradient scale
+    defect. Same tables, same GLOBAL batches, SparseSGD: world=1 and
+    world=8 must produce matching updates — the sparse path's 1/world
+    pre-scale (``sparse_apply_gradients``) exactly cancels the
+    world-times-larger local-mean cotangents under the pmean-averaged
+    loss convention. A failure here would invalidate every cross-topology
+    resume equivalence this suite claims."""
+    rng = np.random.default_rng(11)
+    tables0 = [np.asarray(rng.normal(size=(c["input_dim"],
+                                           c["output_dim"])) * 0.1,
+                          np.float32) for c in CONFIGS]
+
+    def run(world, mesh):
+        de = DistributedEmbedding(CONFIGS, world_size=world)
+        emb_opt, tx = SparseSGD(), optax.sgd(0.2)
+        emb_params = de.set_weights([t.copy() for t in tables0], mesh=mesh)
+        dp = _dp()
+        st = HybridTrainState(
+            emb_params=emb_params, emb_opt_state=emb_opt.init(emb_params),
+            dense_params=dp, dense_opt_state=tx.init(dp),
+            step=jnp.zeros((), jnp.int32))
+        step = make_hybrid_train_step(de, _loss_fn, tx, emb_opt, mesh=mesh,
+                                      lr_schedule=0.3, with_metrics=False)
+        for i in range(3):
+            cats, y = _data(100 + i)
+            if mesh is not None:
+                y = jax.device_put(y, NamedSharding(mesh, P("data")))
+            _, st = step(st, cats, y)
+        return de.get_weights(st.emb_params), np.asarray(
+            st.dense_params["w"])
+
+    t1, w1 = run(1, None)
+    t8, w8 = run(8, mesh8)
+    np.testing.assert_allclose(w1, w8, rtol=1e-5, atol=1e-7)
+    for i, (a, b) in enumerate(zip(t1, t8)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7,
+                                   err_msg=f"table {i}: world=1 vs "
+                                           "world=8 SGD updates diverge")
+
+
+def test_preempt_resume_smaller_mesh_matches_uninterrupted(
+        ck8, de4, mesh4, mesh8, step4, tmp_path, monkeypatch):
+    """The mesh-shrink acceptance path, in process: preempt an 8-rank
+    resilient run at step 2 (real self-SIGTERM), auto-resume it on 4
+    ranks (driver re-shards in place, logs the degradation), and require
+    the final LOGICAL state to match the uninterrupted 8-rank run."""
+    de8, emb_opt, tx = ck8["de"], SparseSGD(), optax.sgd(0.1)
+    step8 = make_hybrid_train_step(de8, _loss_fn, tx, emb_opt, mesh=mesh8,
+                                   lr_schedule=0.2, with_metrics=False)
+    N = 6
+
+    def data_for(mesh):
+        def factory(start):
+            for i in range(start, N):
+                cats, y = _data(200 + i)
+                if mesh is not None:
+                    y = jax.device_put(y, NamedSharding(mesh, P("data")))
+                yield cats, y
+        return factory
+
+    def init8():
+        return init_hybrid_state(de8, emb_opt, _dp(), tx,
+                                 jax.random.key(3), mesh=mesh8)
+
+    # uninterrupted 8-rank reference
+    ref = init8()
+    for item in data_for(mesh8)(0):
+        _, ref = step8(ref, *item)
+    ref_tables = de8.get_weights(ref.emb_params)
+
+    ck = str(tmp_path / "shrink")
+    monkeypatch.setenv("DETPU_FAULT", "preempt@2")
+    r1 = run_resilient(step8, init8(), data_for(mesh8), de=de8,
+                       checkpoint_dir=ck, checkpoint_every_steps=2,
+                       resume=True, emb_optimizer=emb_opt, dense_tx=tx,
+                       mesh=mesh8)
+    monkeypatch.delenv("DETPU_FAULT")
+    assert r1.preempted and r1.stop_reason == "preempted"
+    assert os.path.exists(ck + ".resume.json")
+
+    # resume on the SHRUNKEN mesh — no manual intervention, just a
+    # 4-rank de/mesh; the sgd step4 fixture is Adam, so build SGD's
+    logger = obs.MetricsLogger(str(tmp_path / "m.jsonl"))
+    step4s = make_hybrid_train_step(de4, _loss_fn, tx, emb_opt, mesh=mesh4,
+                                    lr_schedule=0.2, with_metrics=False)
+    st4 = init_hybrid_state(de4, emb_opt, _dp(), tx, jax.random.key(4),
+                            mesh=mesh4)
+    r2 = run_resilient(step4s, st4, data_for(mesh4), de=de4,
+                       checkpoint_dir=ck, checkpoint_every_steps=2,
+                       resume=True, emb_optimizer=emb_opt, dense_tx=tx,
+                       mesh=mesh4, metrics_logger=logger)
+    assert r2.step == N and not r2.preempted
+    assert r2.steps_run == N - r1.step  # no batch replayed or skipped
+    got_tables = de4.get_weights(r2.state.emb_params)
+    for i, (a, b) in enumerate(zip(ref_tables, got_tables)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6,
+                                   err_msg=f"table {i}")
+    # the degradation is in the metrics log
+    recs = obs.MetricsLogger.load(str(tmp_path / "m.jsonl"))
+    reshard = [r for r in recs if r.get("section") == "checkpoint_reshard"]
+    assert reshard and reshard[0]["diff"]["world_size"] == [8, 4]

@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Verify gate for elastic topology resume (run by ``make verify``).
+
+CPU end-to-end mesh-shrink drill:
+
+1. spawn a child training driver on an 8-virtual-device mesh
+   (``run_resilient`` with periodic checkpointing) with a
+   ``DETPU_FAULT=preempt@<step>`` self-SIGTERM — it must checkpoint and
+   exit with ``PREEMPT_EXIT_CODE``;
+2. relaunch the SAME model on a 4-virtual-device mesh — auto-resume must
+   detect the plan/world mismatch, re-shard the checkpoint in place
+   (``on_mismatch='reshard'``, the driver default), log the degradation
+   into its metrics sidecar, and run to completion (exit 0, no manual
+   intervention);
+3. a second 4-device resume from a pristine copy of the same preempted
+   checkpoint must end CRC-identical to the first — the re-shard point
+   starts a deterministic trajectory;
+4. an uninterrupted 8-device reference run must end with the same
+   per-table LOGICAL state (within float tolerance: world size changes
+   the reduction order, never the math).
+
+Exit 0 when the drill passes; 1 with a readable reason otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+STEPS = 8
+PREEMPT_AT = 4
+
+_CHILD = """
+import sys
+sys.path.insert(0, {repo!r})
+import jax, optax, numpy as np, jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from distributed_embeddings_tpu.parallel import (
+    DistributedEmbedding, SparseSGD, init_hybrid_state,
+    make_hybrid_train_step, run_resilient)
+from distributed_embeddings_tpu.utils import obs
+
+world = {world}
+configs = [{{"input_dim": 24 + 3 * i, "output_dim": 8}} for i in range(8)]
+de = DistributedEmbedding(configs, world_size=world,
+                          strategy="memory_balanced")
+mesh = Mesh(np.array(jax.devices()[:world]), ("data",)) \
+    if world > 1 else None
+emb_opt = SparseSGD()
+tx = optax.sgd(0.1)
+dp = {{"w": jnp.ones((8 * 8, 1), jnp.float32) * 0.05}}
+state = init_hybrid_state(de, emb_opt, dp, tx, jax.random.key(0),
+                          mesh=mesh)
+def loss_fn(dparams, outs, batch):
+    x = jnp.concatenate([o.reshape(o.shape[0], -1) for o in outs], axis=1)
+    return jnp.mean((x @ dparams["w"] - batch) ** 2)
+B = 16
+def data(start):
+    for i in range(start, {steps}):
+        rng = np.random.default_rng(900 + i)
+        cats = [jnp.asarray(rng.integers(0, c["input_dim"], B), jnp.int32)
+                for c in configs]
+        y = jnp.asarray(rng.normal(size=(B, 1)), jnp.float32)
+        if mesh is not None:
+            y = jax.device_put(y, NamedSharding(mesh, P("data")))
+        yield cats, y
+step = make_hybrid_train_step(de, loss_fn, tx, emb_opt, mesh=mesh,
+                              lr_schedule=0.2, with_metrics=False,
+                              nan_guard=True)
+logger = obs.MetricsLogger({metrics!r}) if {metrics!r} else None
+r = run_resilient(step, state, data, de=de, checkpoint_dir={ckpt!r},
+                  checkpoint_every_steps=2, resume=True,
+                  emb_optimizer=emb_opt, dense_tx=tx, mesh=mesh,
+                  metrics_logger=logger, exit_on_preempt=True)
+tables = de.get_weights(r.state.emb_params)
+np.savez({tables_out!r}, **{{f"t{{i}}": t for i, t in enumerate(tables)}})
+print("FINAL", r.step, flush=True)
+"""
+
+
+def _run(world, ckpt, tables_out, metrics="", preempt_at=None,
+         timeout=600):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + f" --xla_force_host_platform_device_count={world}")
+    if preempt_at is not None:
+        env["DETPU_FAULT"] = f"preempt@{preempt_at}"
+    else:
+        env.pop("DETPU_FAULT", None)
+    # the drill TESTS the elastic default; an operator's exported
+    # DETPU_ON_MISMATCH=error must not make make verify fail spuriously
+    env["DETPU_ON_MISMATCH"] = "reshard"
+    code = _CHILD.format(repo=REPO, world=world, steps=STEPS, ckpt=ckpt,
+                         tables_out=tables_out, metrics=metrics)
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                          text=True, timeout=timeout)
+    return proc.returncode, proc.stdout
+
+
+def _final_crcs(ckpt):
+    with open(os.path.join(ckpt, "meta.json"), encoding="utf-8") as f:
+        return json.load(f)["files"]
+
+
+def _fail(errors) -> int:
+    for e in errors:
+        print(f"check_reshard: {e}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    from distributed_embeddings_tpu.parallel.resilient import (
+        PREEMPT_EXIT_CODE)
+
+    errors = []
+    with tempfile.TemporaryDirectory(prefix="detpu_reshard_") as tmp:
+        ckpt = os.path.join(tmp, "ck")
+        metrics = os.path.join(tmp, "metrics.jsonl")
+
+        # 1: preempt an 8-device run mid-flight
+        rc, out = _run(8, ckpt, os.path.join(tmp, "t_pre.npz"),
+                       preempt_at=PREEMPT_AT)
+        if rc != PREEMPT_EXIT_CODE:
+            return _fail([f"preempted 8-dev child exited rc={rc} (want "
+                          f"{PREEMPT_EXIT_CODE}): {out.strip()[-500:]}"])
+
+        # pristine copy for the determinism resume (3)
+        ckpt2 = os.path.join(tmp, "ck2")
+        shutil.copytree(ckpt, ckpt2)
+
+        # 2: auto-resume the SAME model on 4 devices — must re-shard and
+        # complete without manual intervention
+        rc, out = _run(4, ckpt, os.path.join(tmp, "t4.npz"),
+                       metrics=metrics)
+        if rc != 0:
+            return _fail([f"4-dev resume failed rc={rc}: "
+                          f"{out.strip()[-800:]}"])
+        if f"FINAL {STEPS}" not in out:
+            errors.append(f"4-dev resume did not reach step {STEPS}: "
+                          f"{out.splitlines()[-3:]}")
+        recs = []
+        if os.path.exists(metrics):
+            with open(metrics, encoding="utf-8") as f:
+                recs = [json.loads(line) for line in f if line.strip()]
+        reshard_recs = [r for r in recs
+                        if r.get("section") == "checkpoint_reshard"]
+        if not reshard_recs:
+            errors.append("no checkpoint_reshard degradation record in "
+                          "the resumed run's metrics sidecar")
+        else:
+            diff = reshard_recs[0].get("diff", {})
+            if diff.get("world_size") != [8, 4]:
+                errors.append(f"degradation record has wrong world sizes: "
+                              f"{diff.get('world_size')}")
+            if not diff.get("per_rank_byte_deltas"):
+                errors.append("degradation record missing per-rank byte "
+                              "deltas")
+
+        # 3: resuming the pristine copy again must be deterministic —
+        # CRC-identical final checkpoint
+        rc, out = _run(4, ckpt2, os.path.join(tmp, "t4b.npz"))
+        if rc != 0:
+            return _fail([f"second 4-dev resume failed rc={rc}: "
+                          f"{out.strip()[-500:]}"])
+        if _final_crcs(ckpt) != _final_crcs(ckpt2):
+            errors.append("two resumes onto the same shrunken mesh wrote "
+                          "different final checkpoints — the re-shard "
+                          "point is not deterministic")
+
+        # 4: uninterrupted 8-device reference — same logical state
+        rc, out = _run(8, os.path.join(tmp, "ref"),
+                       os.path.join(tmp, "t8.npz"))
+        if rc != 0:
+            return _fail([f"8-dev reference failed rc={rc}: "
+                          f"{out.strip()[-500:]}"])
+        got = np.load(os.path.join(tmp, "t4.npz"))
+        ref = np.load(os.path.join(tmp, "t8.npz"))
+        for k in ref.files:
+            if not np.allclose(ref[k], got[k], rtol=1e-5, atol=1e-6):
+                errors.append(
+                    f"logical table {k} differs between the shrunken "
+                    "resume and the uninterrupted 8-dev run (max delta "
+                    f"{np.abs(ref[k] - got[k]).max():.3e})")
+    if errors:
+        return _fail(errors)
+    print("check_reshard: OK (preempted 8-dev run exited "
+          f"{PREEMPT_EXIT_CODE}, auto-resumed on 4 devices via in-place "
+          "re-shard, degradation logged, resume deterministic, final "
+          "logical state matches the uninterrupted run)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
