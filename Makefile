@@ -4,7 +4,7 @@
 SHELL := /bin/bash
 
 .PHONY: all native test test-fast bench bench-diff clean pkg verify \
-        lint audit-step check-backend check-obs check-obs-report \
+        lint audit-step hlo-audit check-backend check-obs check-obs-report \
         check-resilience check-reshard obs-report
 
 all: native
@@ -27,7 +27,7 @@ bench:
 # plus the static gates (detlint rules, the SPMD step auditor, the legacy
 # no-eager-backend shim), the observability gate, and the
 # preemption-recovery drill — run before shipping a round
-verify: lint audit-step check-backend check-obs check-obs-report \
+verify: lint audit-step hlo-audit check-backend check-obs check-obs-report \
         check-resilience check-reshard
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
@@ -47,6 +47,13 @@ lint:
 # (2 fwd + 1 bwd all-to-all, no all_gather, no f64, donations intact)
 audit-step:
 	env JAX_PLATFORMS=cpu python tools/audit_step.py --strict
+
+# optimized-HLO pass-budget auditor: compiles the hybrid step abstractly
+# on the 8-virtual-device CPU mesh and enforces the per-phase pass budgets
+# (dedup phase empty under SparseSGD, <=2 gathers per lookup group, no
+# float convert round-trips; analysis/hlo_census.py)
+hlo-audit:
+	env JAX_PLATFORMS=cpu python tools/hlo_audit.py --strict
 
 # fails if __graft_entry__.py / bench.py reintroduce a pre-probe backend
 # touch (the r5 rc=124 root cause); thin shim over the detlint rule

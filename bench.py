@@ -55,7 +55,7 @@ from distributed_embeddings_tpu.models.dlrm import (
     DLRMConfig, DLRMDense, bce_with_logits)
 from distributed_embeddings_tpu.ops.embedding_lookup import Ragged
 from distributed_embeddings_tpu.parallel import (
-    DistributedEmbedding, HybridTrainState, SparseSGD,
+    DistributedEmbedding, HybridTrainState, SparseAdagrad, SparseSGD,
     make_hybrid_train_loop, make_hybrid_train_step)
 from distributed_embeddings_tpu.utils import obs, power_law_ids
 
@@ -716,6 +716,75 @@ def run_step_memory():
     }
 
 
+def run_phase_budget():
+    """Static per-phase HLO pass census of the headline step (ROADMAP
+    3(a)): the capped bf16 DLRM step is abstractly compiled and its
+    optimized HLO attributed to ``obs.scope`` phases — gather / scatter /
+    sort / cumsum / all-to-all passes and estimated bytes per phase
+    (``analysis/hlo_census.py``). No execution; one extra compile per
+    optimizer family. ``tools/compare_bench.py`` fails a candidate whose
+    per-phase gated pass count GROWS versus the baseline (the analogue of
+    the recompiles==0 gate: a new row-op pass in the hot path is a
+    regression even before it shows up as milliseconds), and fails any
+    record whose census violates its own contracts (the headline SparseSGD
+    build must keep its dedup phase empty).
+
+    The Adagrad twin is censused alongside so the record documents the
+    dedup budget both ways: ``sgd_dedup_row_ops`` must be 0, and
+    ``adagrad_dedup_row_ops`` pins what the stateful family pays for the
+    same shapes."""
+    from distributed_embeddings_tpu.analysis import (
+        census_train_step, default_contracts)
+
+    table_sizes = [min(s, CAP) for s in CRITEO_KAGGLE_SIZES]
+    cfg = make_cfg(table_sizes, jnp.bfloat16)
+    dense = DLRMDense(cfg)
+
+    def loss_fn(dp, emb_outs, b):
+        n, y = b
+        return bce_with_logits(dense.apply(dp, n, emb_outs), y)
+
+    rng = np.random.default_rng(0)
+    num2 = jnp.asarray(rng.normal(size=(2, 13)), jnp.float32)
+    cats = [jax.ShapeDtypeStruct((BATCH,), jnp.int32) for _ in table_sizes]
+    batch_tree = (jax.ShapeDtypeStruct((BATCH, 13), jnp.float32),
+                  jax.ShapeDtypeStruct((BATCH, 1), jnp.float32))
+
+    def one(opt, label):
+        de = DistributedEmbedding(cfg.embedding_configs(), world_size=1,
+                                  compute_dtype=jnp.bfloat16)
+        dense_params = dense.init(
+            jax.random.key(0), num2,
+            [jnp.zeros((2, cfg.embedding_dim), jnp.float32)
+             for _ in table_sizes])
+        # with_metrics/nan_guard pinned like the timed headline sections:
+        # the censused program must not vary with DETPU_OBS, or records
+        # produced with and without it would diff different programs
+        return census_train_step(
+            de, loss_fn, optax.sgd(0.005), opt, cats, batch_tree,
+            dense_params=dense_params, with_metrics=False, nan_guard=False,
+            contracts=default_contracts(opt), label=label)
+
+    sgd = one(SparseSGD(), "bench_headline_sgd")
+    ada = one(SparseAdagrad(), "bench_adagrad_twin")
+
+    def dedup_row_ops(rep):
+        return sum(rep.passes("dedup", k)
+                   for k in ("sort", "scatter", "cumsum", "gather"))
+
+    return {
+        # the headline (SparseSGD) program's per-phase budget — what the
+        # compare_bench gate diffs round over round
+        "phases": sgd.phase_table(),
+        "sgd_dedup_row_ops": dedup_row_ops(sgd),
+        "adagrad_dedup_row_ops": dedup_row_ops(ada),
+        "adagrad_phases": ada.phase_table(),
+        "violations": list(sgd.violations) + list(ada.violations),
+        "total_instructions": sgd.total_instructions,
+        "backend": sgd.backend,
+    }
+
+
 def run_telemetry_overhead():
     """Access-telemetry cost (ISSUE 5): the SAME single-chip DLRM step
     timed with the jit-carried telemetry compiled OUT (the headline
@@ -1042,6 +1111,12 @@ def main():
             # lifted so compare_bench gates per-step peak HBM growth
             # (>10% fails) like any other headline metric
             out["peak_hbm_mb"] = stepmem["peak_hbm_mb"]
+    pb = _guard("phase_budget", run_phase_budget)
+    if pb is not None:
+        # the census rides the record so tools/compare_bench.py can fail a
+        # candidate whose per-phase gated pass counts regress (and any
+        # record whose own pass-budget contracts are violated)
+        out["phase_budget"] = pb
     telov = _guard("telemetry_overhead", run_telemetry_overhead)
     if telov is not None:
         out["telemetry_overhead"] = telov

@@ -6,11 +6,13 @@ backward — and this package verifies it by abstract interpretation
 (jaxpr/StableHLO inspection, no backend execution) instead of by reading
 throughput numbers after the fact. See :mod:`.audit`.
 
-Two sibling layers complete the observatory: :mod:`.telemetry` (on-device
-jit-carried access telemetry — per-table hot-row sketches, per-rank load
-accounting) and :mod:`.memory` (static per-table/slab HBM budgets plus
-compiled-step memory/FLOP reports via abstract lowering). Fused into one
-run report by ``tools/obs_report.py``.
+Three sibling layers complete the observatory: :mod:`.hlo_census` (the
+per-phase op census of the *optimized HLO* — gather/scatter/sort/convert
+pass budgets per ``obs.scope`` phase, enforced by ``tools/hlo_audit.py``),
+:mod:`.telemetry` (on-device jit-carried access telemetry — per-table
+hot-row sketches, per-rank load accounting) and :mod:`.memory` (static
+per-table/slab HBM budgets plus compiled-step memory/FLOP reports via
+abstract lowering). Fused into one run report by ``tools/obs_report.py``.
 """
 
 from .audit import (
@@ -20,6 +22,16 @@ from .audit import (
     audit_step_fn,
     audit_train_step,
     expected_collectives,
+)
+from .hlo_census import (
+    CensusError,
+    CensusReport,
+    PassBudget,
+    census_of_text,
+    census_step_fn,
+    census_train_step,
+    dedup_zero_contracts,
+    default_contracts,
 )
 from .memory import (
     compiled_step_report,
@@ -51,4 +63,12 @@ __all__ = [
     "table_memory_report",
     "compiled_step_report",
     "step_memory_report",
+    "CensusError",
+    "CensusReport",
+    "PassBudget",
+    "census_of_text",
+    "census_step_fn",
+    "census_train_step",
+    "dedup_zero_contracts",
+    "default_contracts",
 ]

@@ -444,6 +444,67 @@ def _audit_step_fn(step_fn, args: Sequence[Any], *,
         violations=violations), out_shape
 
 
+def build_abstract_step(de: DistributedEmbedding,
+                        loss_fn,
+                        dense_tx,
+                        emb_optimizer,
+                        cat_inputs,
+                        batch,
+                        mesh=None,
+                        lr_schedule=1.0,
+                        with_metrics: Optional[bool] = None,
+                        nan_guard: Optional[bool] = None,
+                        telemetry=None,
+                        dense_params=None,
+                        state=None):
+    """Build the hybrid train step EXACTLY like
+    :func:`~..parallel.trainer.make_hybrid_train_step` plus the abstract
+    argument tuple to trace/compile it with — nothing materializes.
+
+    The single build both static gates share: :func:`audit_train_step`
+    (jaxpr/collective contract) and
+    :func:`~.hlo_census.census_train_step` (optimized-HLO pass budget)
+    audit the step this helper returns, so the two cannot drift into
+    auditing different programs while each claims to audit "the" hybrid
+    step. ``with_metrics``/``nan_guard`` default from the env (the step
+    builder's convention); ``state`` is derived via ``eval_shape`` from
+    ``dense_params`` when omitted; a telemetry config appends the
+    abstract carried state as the fourth argument.
+
+    Returns:
+      ``(step, args, state, tel_cfg, with_metrics, nan_guard)``.
+    """
+    from ..utils import obs
+    from . import telemetry as tel
+
+    if with_metrics is None:
+        with_metrics = obs.metrics_enabled()
+    if nan_guard is None:
+        nan_guard = obs.nanguard_enabled()
+    tel_cfg = tel.resolve_config(telemetry)
+
+    if state is None:
+        if dense_params is None:
+            raise ValueError(
+                "building an abstract hybrid step needs dense_params (to "
+                "derive an abstract state) or an explicit state=")
+        state = jax.eval_shape(
+            lambda k, dp: trainer_mod.init_hybrid_state(
+                de, emb_optimizer, dp, dense_tx, k),
+            jax.random.key(0), dense_params)
+
+    step = trainer_mod.make_hybrid_train_step(
+        de, loss_fn, dense_tx, emb_optimizer, mesh=mesh,
+        lr_schedule=lr_schedule, with_metrics=with_metrics,
+        nan_guard=nan_guard, telemetry=tel_cfg if tel_cfg else False)
+
+    args: Tuple[Any, ...] = (state, cat_inputs, batch)
+    if tel_cfg is not None:
+        args = args + (jax.eval_shape(
+            lambda: tel.init_telemetry(de, tel_cfg)),)
+    return step, args, state, tel_cfg, with_metrics, nan_guard
+
+
 def audit_train_step(de: DistributedEmbedding,
                      loss_fn,
                      dense_tx,
@@ -491,30 +552,12 @@ def audit_train_step(de: DistributedEmbedding,
       :class:`AuditReport`; call :meth:`AuditReport.raise_on_violations`
       for strict use.
     """
-    from ..utils import obs
-    from . import telemetry as tel
-
-    if with_metrics is None:
-        with_metrics = obs.metrics_enabled()
-    if nan_guard is None:
-        nan_guard = obs.nanguard_enabled()
-    tel_cfg = tel.resolve_config(telemetry)
-
-    if state is None:
-        if dense_params is None:
-            raise ValueError(
-                "audit_train_step needs dense_params (to derive an "
-                "abstract state) or an explicit state=")
-        key = jax.random.key(0)
-        state = jax.eval_shape(
-            lambda k, dp: trainer_mod.init_hybrid_state(
-                de, emb_optimizer, dp, dense_tx, k),
-            key, dense_params)
-
-    step = trainer_mod.make_hybrid_train_step(
-        de, loss_fn, dense_tx, emb_optimizer, mesh=mesh,
-        lr_schedule=lr_schedule, with_metrics=with_metrics,
-        nan_guard=nan_guard, telemetry=tel_cfg if tel_cfg else False)
+    step, args, state, tel_cfg, with_metrics, nan_guard = \
+        build_abstract_step(
+            de, loss_fn, dense_tx, emb_optimizer, cat_inputs, batch,
+            mesh=mesh, lr_schedule=lr_schedule, with_metrics=with_metrics,
+            nan_guard=nan_guard, telemetry=telemetry,
+            dense_params=dense_params, state=state)
 
     if expected is None:
         expected = expected_collectives(
@@ -522,12 +565,8 @@ def audit_train_step(de: DistributedEmbedding,
             n_dense_leaves=len(jax.tree_util.tree_leaves(
                 state.dense_params)))
 
-    args = (state, cat_inputs, batch)
-    donated = len(jax.tree_util.tree_leaves(state))
-    if tel_cfg is not None:
-        telem = jax.eval_shape(lambda: tel.init_telemetry(de, tel_cfg))
-        args = args + (telem,)
-        donated += len(jax.tree_util.tree_leaves(telem))
+    donated = sum(len(jax.tree_util.tree_leaves(a))
+                  for a in (state,) + args[3:])  # + the telemetry carry
 
     report, out_shape = _audit_step_fn(
         step, args,

@@ -14,7 +14,12 @@ dataflow with static shapes:
   ``.cu:519-528``, becomes a pad-id sentinel + ``mode='drop'`` scatters).
 
 Deduplication is only *required* by optimizers whose update is nonlinear in the
-gradient (Adagrad/Adam); plain SGD can scatter-add duplicates directly.
+gradient (Adagrad/Adam); plain SGD can scatter-add duplicates directly — the
+sparse optimizers declare that via ``needs_dedup`` (:mod:`..parallel.optimizers`)
+and the SGD paths skip this pass entirely (``DETPU_SGD_DEDUP=1`` forces it
+back on for A/B). :func:`dedup_sparse_grad` runs under the ``detpu/dedup``
+named scope so the HLO pass census (:mod:`..analysis.hlo_census`) can
+attribute — and budget — its sort/segment-sum passes per compiled program.
 """
 
 from __future__ import annotations
@@ -81,6 +86,11 @@ def dedup_sparse_grad(ids: jax.Array, grads: jax.Array, *,
       its gradient rows; positions past that hold ``pad_id`` and garbage
       (callers scatter with ``mode='drop'``).
     """
+    with jax.named_scope("detpu/dedup"):
+        return _dedup_sparse_grad(ids, grads, pad_id, valid, max_unique)
+
+
+def _dedup_sparse_grad(ids, grads, pad_id, valid, max_unique):
     n = ids.shape[0]
     u = n if max_unique is None else min(n, int(max_unique))
     if valid is not None:

@@ -24,7 +24,16 @@ dynamic ``num_unique``.
 :class:`SparseAdagrad`, :class:`SparseMomentum` and :class:`SparseAdam` dedup
 duplicate ids first (sort + segment-sum — the CUB sort/unique of the
 reference backward, ``.cu:499-515``) because their updates read-modify-write
-per-row state; :class:`SparseSGD` scatter-adds duplicates directly. Numerics
+per-row state; :class:`SparseSGD` scatter-adds duplicates directly. Every
+optimizer *declares* which regime it needs via the class attribute
+``needs_dedup`` — the statically-enforced dedup pass budget
+(:mod:`..analysis.hlo_census`, ``tools/hlo_audit.py --strict``) requires a
+compiled step's ``detpu/dedup`` phase to hold ZERO row-op passes when the
+optimizer says ``needs_dedup=False``. ``DETPU_SGD_DEDUP=1`` (read at step
+build time) forces the dedup pass back into the SGD path for A/B
+comparison: the trajectories are mathematically identical (SGD is linear in
+the gradient), so the knob exists purely to measure what the skipped pass
+would cost and to regression-test the equivalence. Numerics
 match ``optax.sgd`` / ``optax.adagrad`` (initial accumulator 0.1, eps 1e-7) /
 ``optax.sgd(momentum=...)`` / ``optax.adam`` so the dense data-parallel side
 can use optax and both families see the same optimizer semantics.
@@ -49,6 +58,17 @@ from jax import lax
 
 from ..ops.packed_slab import expand_lane_mask, pack_factor
 from ..ops.sparse_grad import dedup_sparse_grad
+from ..utils import envvars
+
+SGD_DEDUP_ENV = "DETPU_SGD_DEDUP"
+
+
+def sgd_dedup_forced() -> bool:
+    """Whether ``DETPU_SGD_DEDUP=1`` asks the linear (SGD) paths to run the
+    dedup pass they would otherwise skip. Read at step-BUILD time (like
+    ``with_metrics``): flipping the env after a step is compiled changes
+    nothing until the step is rebuilt."""
+    return envvars.enabled(SGD_DEDUP_ENV)
 
 
 # The explicit-sort scatter wins only in a WINDOW of stream lengths —
@@ -89,7 +109,18 @@ def _sorted_scatter_add(slab: jax.Array, ids: jax.Array,
 
 
 class SparseSGD:
-    """Plain SGD on slab rows; duplicate ids accumulate via scatter-add."""
+    """Plain SGD on slab rows; duplicate ids accumulate via scatter-add.
+
+    ``needs_dedup=False``: the update is linear in the gradient, so
+    duplicate ids are scatter-add-safe (``ops/sparse_grad.py``) and the
+    sort + segment-sum dedup pass is skipped entirely — the first
+    statically-verified pass cut of ROADMAP 3(a); ``tools/hlo_audit.py
+    --strict`` pins the compiled dedup phase to zero row ops on this path.
+    ``DETPU_SGD_DEDUP=1`` forces the pass back in for A/B (mathematically
+    identical; floating-point-identical too whenever the per-row sums are
+    exact, which the equivalence test engineers)."""
+
+    needs_dedup = False
 
     def init(self, params):
         return jax.tree.map(lambda _: (), params)
@@ -97,6 +128,15 @@ class SparseSGD:
     def apply_rows(self, slab: jax.Array, state, ids: jax.Array,
                    vals: jax.Array, lr):
         """``slab[ids] -= lr * vals``; ids >= slab rows are dropped."""
+        if sgd_dedup_forced():
+            # A/B escape hatch: pre-sum duplicate rows exactly like the
+            # stateful optimizers do, then scatter the unique rows
+            uids, uvals = dedup_sparse_grad(ids, vals,
+                                            pad_id=slab.shape[0],
+                                            max_unique=slab.shape[0] + 1)
+            return slab.at[uids].add(
+                (-lr * uvals).astype(slab.dtype), mode="drop",
+                indices_are_sorted=True), state
         slab = _sorted_scatter_add(slab, ids,
                                    -lr * vals.astype(slab.dtype))
         return slab, state
@@ -107,6 +147,10 @@ class SparseAdagrad:
     (accumulator init 0.1, ``param -= lr * g * rsqrt(acc_new + eps)``).
 
     Two execution regimes, chosen per call by a measured cost model:
+
+    ``needs_dedup=True``: the accumulator update is nonlinear in the
+    gradient, so duplicate rows must be summed before the rsqrt (the
+    sparse regime's sort + segment-sum pass, budgeted by the HLO census).
 
     * **sparse** (stream << slab rows): sort-dedup the id stream, then
       per-unique-row accumulator read-modify-write — 4-5 random row ops on
@@ -120,6 +164,8 @@ class SparseAdagrad:
       stream cost (VERDICT r3 Weak #3): 4 full-stream row ops became one
       scatter + slab-wide elementwise passes.
     """
+
+    needs_dedup = True
 
     def __init__(self, initial_accumulator_value: float = 0.1,
                  eps: float = 1e-7, dense_apply_ratio: float = 6.0):
@@ -222,6 +268,7 @@ class SparseMomentum:
     ``g + decay * trace_new``). See the module docstring for the lazy
     semantics of untouched rows."""
 
+    needs_dedup = True
     needs_touch_mask = True
 
     def __init__(self, momentum: float = 0.9, nesterov: bool = False):
@@ -260,6 +307,7 @@ class SparseAdam:
     State per width slab: ``(mu, nu, count)`` where ``count`` rides as a
     ``[..., 1, 1]`` array so it shards/squeezes uniformly with the slabs."""
 
+    needs_dedup = True
     needs_touch_mask = True
 
     def __init__(self, b1: float = 0.9, b2: float = 0.999,

@@ -55,7 +55,7 @@ from jax import lax
 
 from ..ops.embedding_lookup import IdsLike, Ragged, SparseIds, embedding_lookup
 from ..utils import obs
-from .optimizers import _SORT_STREAM_MAX, _SORT_STREAM_MIN
+from .optimizers import _SORT_STREAM_MAX, _SORT_STREAM_MIN, sgd_dedup_forced
 
 
 def _sorted_decl(n: int) -> bool:
@@ -72,12 +72,18 @@ def _sorted_decl(n: int) -> bool:
 @struct.dataclass
 class SparseRows:
     """IndexedSlices analogue: ``rows[k]`` is the gradient (or update) for
-    table row ``ids[k]``; ``ids`` are sorted, unique, with unused capacity
-    marked ``>= vocab`` (dropped by scatters)."""
+    table row ``ids[k]``; unused capacity is marked ``>= vocab`` (dropped
+    by scatters). ``unique=True`` (the default, what
+    :func:`sparse_value_and_grad` builds under ``dedup=True``) additionally
+    guarantees the ids are sorted and duplicate-free — stateful
+    (read-modify-write) optimizers require that; the linear SGD transform
+    and :func:`apply_sparse_updates` accept ``unique=False`` rows (the
+    dedup-skipped path) and simply scatter-add the repeats."""
 
     ids: jax.Array  # [U] int32
     rows: jax.Array  # [U, width]
     vocab: int = struct.field(pytree_node=False)
+    unique: bool = struct.field(pytree_node=False, default=True)
 
 
 def unique_ids_static(ids: jax.Array, vocab: int,
@@ -151,7 +157,8 @@ def _remap(inp: IdsLike, inv_slice: jax.Array) -> IdsLike:
 def sparse_value_and_grad(loss_fn: Callable,
                           combiners: Sequence[Optional[str]],
                           input_table_map: Optional[Sequence[int]] = None,
-                          has_aux: bool = False):
+                          has_aux: bool = False,
+                          dedup: bool = True):
     """Build ``f(dense_params, tables, inputs, *args) -> (loss,
     (dense_grads, sparse_grads))`` with table gradients in O(touched rows).
 
@@ -165,6 +172,19 @@ def sparse_value_and_grad(loss_fn: Callable,
         (default: identity — one input per table). Inputs sharing a table
         dedup jointly, so shared tables still see one unique-row gather.
       has_aux: forwarded to ``jax.value_and_grad``.
+      dedup: ``True`` (default) runs the :func:`unique_ids_static`
+        sort-unique pass per table, yielding ``unique=True``
+        :class:`SparseRows` every ``sparse_rows_*`` transform accepts.
+        ``False`` SKIPS that pass entirely — the ROADMAP 3(a) SGD dedup
+        cut: the per-position rows are gathered directly (bitwise the same
+        forward: a gather of a gather of the same clamped ids) and the
+        returned rows carry the raw clamped id stream with
+        ``unique=False``, which only gradient-LINEAR consumers
+        (:func:`sparse_rows_sgd`, :func:`apply_sparse_updates`) accept —
+        duplicates scatter-add exactly; the stateful transforms raise.
+        One sort + cumsum + two scatters + an inverse-permutation gather
+        per table per step are eliminated. ``DETPU_SGD_DEDUP=1`` (checked
+        at build time) forces ``dedup=True`` back on for A/B.
 
     Returns a function over ``tables``: a list (or dict values in order) of
     dense ``[vocab, width]`` arrays. Its ``sparse_grads`` output is a list
@@ -172,6 +192,8 @@ def sparse_value_and_grad(loss_fn: Callable,
     ``sparse_rows_*`` transform + :func:`apply_sparse_updates`.
     """
     combiners = list(combiners)
+    if not dedup and sgd_dedup_forced():
+        dedup = True  # the A/B escape hatch wins over the caller's skip
 
     def f(dense_params, tables: Sequence[jax.Array], inputs: Sequence[IdsLike],
           *args):
@@ -193,7 +215,18 @@ def sparse_value_and_grad(loss_fn: Callable,
             if not parts:
                 raise ValueError(f"Table {t} has no inputs")
             cat = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
-            u, inv = unique_ids_static(cat, tables[t].shape[0])
+            vocab = tables[t].shape[0]
+            if dedup:
+                u, inv = unique_ids_static(cat, vocab)
+            else:
+                # dedup skipped: the "unique" rows are simply the
+                # per-position rows under the same [0, vocab] clamp
+                # unique_ids_static applies (negative -> row 0 symmetric
+                # with the read; > vocab -> the dropped sentinel), and the
+                # remap indices are the identity — the forward gather chain
+                # and the update contract are bitwise unchanged
+                u = jnp.clip(cat.astype(jnp.int32), 0, jnp.int32(vocab))
+                inv = jnp.arange(cat.shape[0], dtype=jnp.int32)
             uids.append(u)
             invs.append(inv)
             # one gather per DISTINCT row (pad ids clip into the last row,
@@ -215,7 +248,8 @@ def sparse_value_and_grad(loss_fn: Callable,
 
         (loss, *aux), (dgrads, rgrads) = _vg(inner, has_aux)(
             dense_params, urows)
-        sgrads = [SparseRows(ids=u, rows=g, vocab=tables[t].shape[0])
+        sgrads = [SparseRows(ids=u, rows=g, vocab=tables[t].shape[0],
+                             unique=dedup)
                   for t, (u, g) in enumerate(zip(uids, rgrads))]
         if has_aux:
             return (loss, aux[0]), (dgrads, sgrads)
@@ -269,9 +303,24 @@ def _resolve_lr(lr, count):
     return lr(count) if callable(lr) else lr
 
 
+def _require_unique(g: "SparseRows", who: str) -> None:
+    """Stateful (read-modify-write) transforms need sorted-unique rows: a
+    duplicated id would read stale state for its second occurrence. Raise
+    at trace time rather than silently corrupt."""
+    if not g.unique:
+        raise ValueError(
+            f"{who} requires unique SparseRows (duplicate ids would "
+            "read-modify-write stale per-row state) — build the gradients "
+            "with sparse_value_and_grad(dedup=True); dedup=False is only "
+            "valid for gradient-linear consumers (sparse_rows_sgd, "
+            "apply_sparse_updates)")
+
+
 def sparse_rows_sgd(learning_rate) -> optax.GradientTransformation:
     """SGD over :class:`SparseRows` gradients: update rows are
-    ``-lr * grad_rows``; dense (non-SparseRows) leaves get plain SGD."""
+    ``-lr * grad_rows``; dense (non-SparseRows) leaves get plain SGD.
+    Linear in the gradient, so ``unique=False`` (dedup-skipped) rows are
+    accepted — duplicates accumulate exactly in the apply scatter."""
 
     def init(params):
         del params
@@ -284,7 +333,7 @@ def sparse_rows_sgd(learning_rate) -> optax.GradientTransformation:
         def one(g):
             if isinstance(g, SparseRows):
                 return SparseRows(ids=g.ids, rows=-lr * g.rows,
-                                  vocab=g.vocab)
+                                  vocab=g.vocab, unique=g.unique)
             return -lr * g
         return _tree_rows(one, updates), {"count": state["count"] + 1}
 
@@ -314,6 +363,7 @@ def sparse_rows_adagrad(learning_rate,
             if not isinstance(g, SparseRows):
                 new = acc + g * g
                 return _Out(-lr * g * lax.rsqrt(new + eps), new)
+            _require_unique(g, "sparse_rows_adagrad")
             rows = g.rows.astype(acc.dtype)
             # scatter-add FIRST, gather the updated rows after: the
             # accumulator's only write is a single-use scatter-add, which
@@ -356,6 +406,7 @@ def sparse_rows_momentum(learning_rate, momentum: float = 0.9,
                 t_new = g + momentum * tr
                 step = g + momentum * t_new if nesterov else t_new
                 return _Out(-lr * step, t_new)
+            _require_unique(g, "sparse_rows_momentum")
             rows = g.rows.astype(tr.dtype)
             srt = _sorted_decl(g.ids.shape[0])
             # the affine state transition t <- m*t + g runs as two single-
@@ -405,6 +456,7 @@ def sparse_rows_adam(learning_rate, b1: float = 0.9, b2: float = 0.999,
                 return _Out(
                     -lr * mu_hat / (jnp.sqrt(nu_hat + eps_root) + eps),
                     mu_n, nu_n)
+            _require_unique(g, "sparse_rows_adam")
             rows = g.rows.astype(mu.dtype)
             srt = _sorted_decl(g.ids.shape[0])
             # affine moment transitions as in-place-able multiply+add
@@ -441,9 +493,12 @@ def apply_sparse_updates(params, updates):
     def one(p, u):
         if isinstance(u, SparseRows):
             with obs.scope("sparse_rows_apply"):
+                # unique=False rows (dedup skipped) are unsorted: declaring
+                # sortedness would be a lie XLA is allowed to punish
+                srt = u.unique and _sorted_decl(u.ids.shape[0])
                 return p.at[u.ids].add(
                     u.rows.astype(p.dtype), mode="drop",
-                    indices_are_sorted=_sorted_decl(u.ids.shape[0]))
+                    indices_are_sorted=srt)
         return p + u
     return jax.tree.map(one, params, updates,
                         is_leaf=lambda x: isinstance(x, SparseRows))

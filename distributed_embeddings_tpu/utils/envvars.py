@@ -177,6 +177,15 @@ declare("DETPU_BENCH_SIDECAR", default="BENCH.partial.jsonl",
 declare("DETPU_BENCH_SECTION_DEADLINE_S", default="1200",
         doc="best-effort SIGALRM deadline (seconds) per bench section")
 
+# sparse optimizer paths (parallel/optimizers.py, parallel/sparse_optax.py)
+declare("DETPU_SGD_DEDUP", default="",
+        doc="1 = force the sort/segment-sum dedup pass back INTO the "
+            "SGD sparse paths that statically skip it (SparseSGD declares "
+            "needs_dedup=False; sparse_value_and_grad(dedup=False)) — the "
+            "A/B escape hatch for the ROADMAP 3(a) pass cut. Read at step "
+            "BUILD time; trajectories are mathematically identical either "
+            "way (SGD is linear in the gradient)")
+
 # debug / test harness
 declare("DETPU_DEBUG_LANE_EXTRACT", default="0",
         doc="1 = swap the packed-slab lane extraction for the reference "

@@ -15,7 +15,8 @@ import pytest
 from distributed_embeddings_tpu.utils import envvars
 from tools import detlint
 from tools.detlint.rules import (bare_except, eager_backend, env_registry,
-                                 host_fetch, module_scope_jax, named_scope)
+                                 host_fetch, module_scope_jax, named_scope,
+                                 unsized_unique)
 
 CTX = {"repo": detlint.REPO}
 PARALLEL = "distributed_embeddings_tpu/parallel/x.py"
@@ -85,6 +86,37 @@ def test_named_scope_rule():
     assert not _check(named_scope, ok)
 
 
+def test_unsized_unique_rule():
+    """The seeded-violation drill: jnp.unique/nonzero without size= in
+    package code fires; size=, the unsized-ok marker, host-side numpy,
+    and out-of-package paths stay quiet."""
+    path = "distributed_embeddings_tpu/analysis/x.py"
+    bad = ("import jax.numpy as jnp\n"
+           "def f(ids):\n"
+           "    return jnp.unique(ids)\n")
+    assert _check(unsized_unique, bad, path=path)
+    assert _check(unsized_unique,
+                  "import jax\n"
+                  "def f(x):\n"
+                  "    return jax.numpy.nonzero(x)\n", path=path)
+    ok = ("import jax.numpy as jnp\n"
+          "def f(ids):\n"
+          "    return jnp.unique(ids, size=32, fill_value=0)\n")
+    assert not _check(unsized_unique, ok, path=path)
+    annotated = ("import jax.numpy as jnp\n"
+                 "def f(ids):\n"
+                 "    return jnp.unique(ids)  # unsized-ok: eager tooling\n")
+    assert not _check(unsized_unique, annotated, path=path)
+    # host-side numpy is a different module
+    assert not _check(unsized_unique,
+                      "import numpy as np\n"
+                      "def f(x):\n"
+                      "    return np.unique(x)\n", path=path)
+    # the rule is scoped to package code only (the runner's SCOPE filter)
+    assert detlint._matches(path, unsized_unique.SCOPE)
+    assert not detlint._matches("tools/x.py", unsized_unique.SCOPE)
+
+
 def test_module_scope_jax_rule():
     path = "distributed_embeddings_tpu/utils/obs.py"
     assert _check(module_scope_jax, "import jax\n", path=path)
@@ -100,7 +132,8 @@ def test_module_scope_jax_rule():
 def test_discover_rules_finds_all():
     rules = detlint.discover_rules()
     assert {"bare-except", "eager-backend", "env-registry", "host-fetch",
-            "module-scope-jax", "named-scope-exchange"} <= set(rules)
+            "module-scope-jax", "named-scope-exchange",
+            "unsized-unique"} <= set(rules)
 
 
 def test_unknown_rule_name_raises():

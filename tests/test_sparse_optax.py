@@ -384,3 +384,80 @@ def test_negative_ids_train_row_zero_not_tail():
         np.any(np.asarray(newt) != np.asarray(table), axis=1))[0]
     # rows 0 (the clamped negatives) and 3 train; the tail must not
     np.testing.assert_array_equal(changed, [0, 3])
+
+
+# ------------------------------------------- the dedup-skip (SGD) pass cut
+
+
+def test_dedup_false_forward_and_grads_match():
+    """sparse_value_and_grad(dedup=False) skips the unique_ids_static
+    sort pass: the forward loss is BITWISE the dedup=True value (a gather
+    of a gather of the same clamped ids) and the scattered-dense gradient
+    matches; the rows come back unique=False carrying the raw clamped
+    stream."""
+    rng = np.random.default_rng(11)
+    vocab, w, b = 12, 8, 16  # small vocab => guaranteed duplicates
+    table = jnp.asarray(rng.normal(size=(vocab, w)), jnp.float32)
+    ids = jnp.asarray(rng.integers(0, vocab, size=(b, 3)), jnp.int32)
+    tgt = jnp.asarray(rng.normal(size=(b, w)), jnp.float32)
+
+    def loss_fn(dp, outs, t):
+        return jnp.mean((outs[0] * dp["s"] - t) ** 2)
+
+    dp = {"s": jnp.float32(1.3)}
+    f_dd = sparse_value_and_grad(loss_fn, combiners=["sum"], dedup=True)
+    f_nd = sparse_value_and_grad(loss_fn, combiners=["sum"], dedup=False)
+    loss_dd, (_, sg_dd) = f_dd(dp, [table], [ids], tgt)
+    loss_nd, (dg_nd, sg_nd) = f_nd(dp, [table], [ids], tgt)
+    np.testing.assert_array_equal(np.asarray(loss_dd), np.asarray(loss_nd))
+    assert sg_dd[0].unique and not sg_nd[0].unique
+    assert sg_nd[0].ids.shape[0] == b * 3  # the raw stream, no unique pass
+    np.testing.assert_allclose(_scatter_dense(sg_nd[0]),
+                               _scatter_dense(sg_dd[0]),
+                               rtol=1e-5, atol=1e-6)
+    # the linear transform + apply path accepts non-unique rows
+    tx = sparse_rows_sgd(0.5)
+    upd, _ = tx.update(sg_nd, tx.init([table]), [table])
+    assert not upd[0].unique  # the flag must survive the transform
+    [t_nd] = apply_sparse_updates([table], upd)
+    upd_dd, _ = tx.update(sg_dd, tx.init([table]), [table])
+    [t_dd] = apply_sparse_updates([table], upd_dd)
+    np.testing.assert_allclose(np.asarray(t_nd), np.asarray(t_dd),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_dedup_false_stateful_transforms_refuse():
+    """Stateful (read-modify-write) transforms must reject unique=False
+    rows at trace time instead of silently reading stale state for the
+    second occurrence of a duplicated id."""
+    table = jnp.zeros((8, 4), jnp.float32)
+    rows = SparseRows(ids=jnp.asarray([3, 3, 1], jnp.int32),
+                      rows=jnp.ones((3, 4), jnp.float32), vocab=8,
+                      unique=False)
+    for name, tx in [("adagrad", sparse_rows_adagrad(0.1)),
+                     ("momentum", sparse_rows_momentum(0.1)),
+                     ("adam", sparse_rows_adam(0.1))]:
+        with pytest.raises(ValueError, match="requires unique"):
+            tx.update([rows], tx.init([table]), [table])
+
+
+def test_dedup_false_env_hatch_forces_dedup_back(monkeypatch):
+    """DETPU_SGD_DEDUP=1 (the A/B escape hatch) overrides dedup=False at
+    build time: the returned rows are sorted-unique again."""
+    monkeypatch.setenv("DETPU_SGD_DEDUP", "1")
+    table = jnp.asarray(np.arange(40.0).reshape(10, 4), jnp.float32)
+    ids = jnp.asarray([3, 3, 7], jnp.int32)
+
+    def loss_fn(dp, outs, *a):
+        del dp, a
+        return jnp.sum(outs[0])
+
+    f = sparse_value_and_grad(loss_fn, combiners=[None], dedup=False)
+    _, (_, sg) = f({}, [table], [ids])
+    assert sg[0].unique
+    u = np.asarray(sg[0].ids)
+    assert (np.diff(u) >= 0).all()
+    monkeypatch.delenv("DETPU_SGD_DEDUP")
+    f2 = sparse_value_and_grad(loss_fn, combiners=[None], dedup=False)
+    _, (_, sg2) = f2({}, [table], [ids])
+    assert not sg2[0].unique

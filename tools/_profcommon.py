@@ -40,6 +40,130 @@ CRITEO_KAGGLE_SIZES = [
 CAP_SIZES = [min(s, CAP) for s in CRITEO_KAGGLE_SIZES]
 
 
+def build_case(name: str, world: int, batch: int):
+    """One reference DistributedEmbedding configuration: ``(de, cat_inputs,
+    batch_tree, dense_params, loss_fn)`` with abstract (ShapeDtypeStruct)
+    inputs — the shapes the static tools audit. Shared by
+    ``tools/audit_step.py`` (jaxpr-level SPMD contract) and
+    ``tools/hlo_audit.py`` (optimized-HLO pass budgets) so both gates and
+    the profile tools cannot drift apart.
+
+    Cases: ``dense`` / ``ragged`` / ``row_sliced`` (the tier-1 shapes) and
+    ``bigvocab`` — vocab rows >> the id stream, so stateful sparse
+    optimizers compile their sort-dedup path instead of the dense-apply
+    regime (the configuration the dedup pass budget is pinned on).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_embeddings_tpu.ops.embedding_lookup import Ragged
+    from distributed_embeddings_tpu.parallel import DistributedEmbedding
+
+    def loss_fn(dp, emb_outs, b):
+        n, y = b
+        x = jnp.concatenate([e.reshape(e.shape[0], -1) for e in emb_outs],
+                            axis=1)
+        return jnp.mean((x @ dp["w"] + n @ dp["v"] - y) ** 2)
+
+    def dense_cats(configs):
+        cats = []
+        for cfg in configs:
+            hot = 1 if cfg["combiner"] is None else 3
+            shape = (batch,) if hot == 1 else (batch, hot)
+            cats.append(jax.ShapeDtypeStruct(shape, jnp.int32))
+        return cats
+
+    if name == "dense":
+        configs = [{"input_dim": 20 + 6 * i, "output_dim": 4,
+                    "combiner": ["sum", None, "mean"][i % 3]}
+                   for i in range(10)]
+        de = DistributedEmbedding(configs, world_size=world)
+        cats = dense_cats(configs)
+    elif name == "bigvocab":
+        # stream << rows: SparseAdagrad's dense_apply_ratio cost model
+        # (stream * ratio > slab rows) cannot trigger, so the compiled
+        # program holds the sort + segment-sum dedup passes the census
+        # budgets; under SparseSGD the same shapes must compile dedup-free
+        configs = [{"input_dim": 5000 + 400 * i, "output_dim": 8,
+                    "combiner": ["sum", None, "mean"][i % 3]}
+                   for i in range(10)]
+        de = DistributedEmbedding(configs, world_size=world)
+        cats = dense_cats(configs)
+    elif name == "ragged":
+        configs = [{"input_dim": 40 + 7 * i, "output_dim": 8,
+                    "combiner": "sum" if i % 2 else "mean"}
+                   for i in range(8)]
+        de = DistributedEmbedding(configs, world_size=world)
+        local_b = batch // max(world, 1)
+        cap = local_b * 4
+        cats = [Ragged(values=jax.ShapeDtypeStruct((world * cap,),
+                                                   jnp.int32),
+                       row_splits=jax.ShapeDtypeStruct(
+                           (world * (local_b + 1),), jnp.int32))
+                for _ in configs]
+    elif name == "row_sliced":
+        configs = [
+            {"input_dim": 100, "output_dim": 8, "combiner": None},
+            {"input_dim": 30, "output_dim": 8, "combiner": "sum"},
+            {"input_dim": 100, "output_dim": 8, "combiner": "mean"},
+            {"input_dim": 40, "output_dim": 8, "combiner": None},
+            {"input_dim": 26, "output_dim": 8, "combiner": "sum"},
+            {"input_dim": 100, "output_dim": 4, "combiner": "sum"},
+            {"input_dim": 22, "output_dim": 8, "combiner": None},
+            {"input_dim": 24, "output_dim": 8, "combiner": None},
+        ]
+        # the 100-row tables split into 4 row-range slices
+        de = DistributedEmbedding(configs, world_size=world,
+                                  row_slice=100 * 8 // 4 + 1)
+        cats = dense_cats(configs)
+    else:
+        raise ValueError(f"unknown config {name!r}")
+
+    cols = sum(int(c["output_dim"]) for c in configs)
+    dense_params = {"w": jax.ShapeDtypeStruct((cols, 1), jnp.float32),
+                    "v": jax.ShapeDtypeStruct((3, 1), jnp.float32)}
+    batch_tree = (jax.ShapeDtypeStruct((batch, 3), jnp.float32),
+                  jax.ShapeDtypeStruct((batch, 1), jnp.float32))
+    return de, cats, batch_tree, dense_params, loss_fn
+
+
+def force_cpu(devices: int) -> None:
+    """Pin the static audit tools to an N-virtual-device CPU backend.
+
+    Must run before the process's first jax import: the auditors are pure
+    static tools and must never touch (or wait on) an accelerator
+    backend. Shared by ``tools/audit_step.py`` and ``tools/hlo_audit.py``
+    so the two gates cannot drift in WHICH program they audit: an
+    inherited ``DETPU_OBS=1`` / ``DETPU_TELEMETRY=1`` would flip the
+    audited step to an instrumented variant, and an exported
+    ``DETPU_SGD_DEDUP=1`` would force the dedup pass back into the SGD
+    builds — both gates audit the default program; the variants are
+    audited explicitly (``--with-metrics``/``--with-telemetry``,
+    ``--sgd-dedup``, tests)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={devices}")
+    for knob in ("DETPU_OBS", "DETPU_TELEMETRY", "DETPU_SGD_DEDUP"):
+        os.environ.pop(knob, None)
+
+
+def cpu_mesh(world: int):
+    """A ``("data",)`` Mesh over the first ``world`` host-platform devices
+    (``None`` for world <= 1). :func:`force_cpu` must have run first."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    if world <= 1:
+        return None
+    devs = jax.devices()  # backend-ok: force_cpu ran before jax import
+    if len(devs) < world:
+        raise RuntimeError(
+            f"host platform exposes {len(devs)} devices < {world}")
+    return Mesh(np.array(devs[:world]), ("data",))
+
+
 def ensure_backend(timeout_s: float | None = None):
     """Probe the backend BEFORE this process's first jax touch.
 

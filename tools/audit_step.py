@@ -26,105 +26,26 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _force_cpu(devices: int) -> None:
-    """Must run before the first jax import: the auditor is a pure static
-    tool and must never touch (or wait on) an accelerator backend."""
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + f" --xla_force_host_platform_device_count={devices}")
-    # an inherited DETPU_OBS=1 / DETPU_TELEMETRY=1 would flip the audited
-    # step to an instrumented variant; audit the shapes explicitly instead
-    os.environ.pop("DETPU_OBS", None)
-    os.environ.pop("DETPU_TELEMETRY", None)
-
-
-def build_case(name: str, world: int, batch: int):
-    """One reference configuration: ``(de, cat_inputs, batch_tree,
-    dense_params, loss_fn)`` with abstract (ShapeDtypeStruct) inputs."""
-    import jax
-    import jax.numpy as jnp
-
-    from distributed_embeddings_tpu.ops.embedding_lookup import Ragged
-    from distributed_embeddings_tpu.parallel import DistributedEmbedding
-
-    def loss_fn(dp, emb_outs, b):
-        n, y = b
-        x = jnp.concatenate([e.reshape(e.shape[0], -1) for e in emb_outs],
-                            axis=1)
-        return jnp.mean((x @ dp["w"] + n @ dp["v"] - y) ** 2)
-
-    if name == "dense":
-        configs = [{"input_dim": 20 + 6 * i, "output_dim": 4,
-                    "combiner": ["sum", None, "mean"][i % 3]}
-                   for i in range(10)]
-        de = DistributedEmbedding(configs, world_size=world)
-        cats = []
-        for cfg in configs:
-            hot = 1 if cfg["combiner"] is None else 3
-            shape = (batch,) if hot == 1 else (batch, hot)
-            cats.append(jax.ShapeDtypeStruct(shape, jnp.int32))
-    elif name == "ragged":
-        configs = [{"input_dim": 40 + 7 * i, "output_dim": 8,
-                    "combiner": "sum" if i % 2 else "mean"}
-                   for i in range(8)]
-        de = DistributedEmbedding(configs, world_size=world)
-        local_b = batch // max(world, 1)
-        cap = local_b * 4
-        cats = [Ragged(values=jax.ShapeDtypeStruct((world * cap,),
-                                                   jnp.int32),
-                       row_splits=jax.ShapeDtypeStruct(
-                           (world * (local_b + 1),), jnp.int32))
-                for _ in configs]
-    elif name == "row_sliced":
-        configs = [
-            {"input_dim": 100, "output_dim": 8, "combiner": None},
-            {"input_dim": 30, "output_dim": 8, "combiner": "sum"},
-            {"input_dim": 100, "output_dim": 8, "combiner": "mean"},
-            {"input_dim": 40, "output_dim": 8, "combiner": None},
-            {"input_dim": 26, "output_dim": 8, "combiner": "sum"},
-            {"input_dim": 100, "output_dim": 4, "combiner": "sum"},
-            {"input_dim": 22, "output_dim": 8, "combiner": None},
-            {"input_dim": 24, "output_dim": 8, "combiner": None},
-        ]
-        # the 100-row tables split into 4 row-range slices
-        de = DistributedEmbedding(configs, world_size=world,
-                                  row_slice=100 * 8 // 4 + 1)
-        cats = []
-        for cfg in configs:
-            hot = 1 if cfg["combiner"] is None else 3
-            shape = (batch,) if hot == 1 else (batch, hot)
-            cats.append(jax.ShapeDtypeStruct(shape, jnp.int32))
-    else:
-        raise ValueError(f"unknown config {name!r}")
-
-    cols = sum(int(c["output_dim"]) for c in configs)
-    dense_params = {"w": jax.ShapeDtypeStruct((cols, 1), jnp.float32),
-                    "v": jax.ShapeDtypeStruct((3, 1), jnp.float32)}
-    batch_tree = (jax.ShapeDtypeStruct((batch, 3), jnp.float32),
-                  jax.ShapeDtypeStruct((batch, 1), jnp.float32))
-    return de, cats, batch_tree, dense_params, loss_fn
+# reference configurations + CPU pinning live in tools/_profcommon.py
+# (shared with tools/hlo_audit.py and the profile tools so the audited
+# shapes AND the audited program — which env knobs are stripped — cannot
+# drift); build_case re-exported because tests and docs address it here
+try:  # imported as the tools.audit_step module (tests, tooling)
+    from tools._profcommon import build_case, cpu_mesh, force_cpu  # noqa: F401
+except ImportError:  # run as a script: tools/ itself is sys.path[0]
+    from _profcommon import build_case, cpu_mesh, force_cpu  # noqa: F401
 
 
 def audit_case(name: str, world: int, batch: int, with_metrics: bool,
                with_telemetry: bool = False):
-    import jax
-    import numpy as np
     import optax
-    from jax.sharding import Mesh
 
     from distributed_embeddings_tpu.analysis import audit_train_step
     from distributed_embeddings_tpu.parallel import SparseAdagrad
 
     de, cats, batch_tree, dense_params, loss_fn = build_case(
         name, world, batch)
-    mesh = None
-    if world > 1:
-        devs = jax.devices()  # backend-ok: JAX_PLATFORMS=cpu forced above
-        if len(devs) < world:
-            raise RuntimeError(
-                f"host platform exposes {len(devs)} devices < {world}")
-        mesh = Mesh(np.array(devs[:world]), ("data",))
+    mesh = cpu_mesh(world)
     suffix = "/telemetry" if with_telemetry else ""
     return audit_train_step(
         de, loss_fn, optax.sgd(0.5), SparseAdagrad(), cats, batch_tree,
@@ -151,7 +72,7 @@ def main(argv=None) -> int:
                     help="dump the full reports as JSON (- for stdout)")
     args = ap.parse_args(argv)
 
-    _force_cpu(max(args.world, 1))
+    force_cpu(max(args.world, 1))
     sys.path.insert(0, REPO)
 
     names = (["dense", "ragged", "row_sliced"] if args.config == "all"

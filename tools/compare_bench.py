@@ -13,7 +13,9 @@ Accepts either the driver's wrapper format (``{"rc": ..., "parsed":
 * 0 — every comparable metric within the threshold;
 * 1 — at least one regression beyond the threshold (throughput metrics
   dropping, or ms-per-iter metrics rising, by more than ``--threshold``,
-  default 10%);
+  default 10%), a nonzero steady-state recompile count, or a per-phase
+  HLO pass-count regression / contract violation in the candidate's
+  ``phase_budget`` census (:func:`check_phase_budget`);
 * 2 — unusable inputs (missing file, no parseable payload).
 
 Metrics present in only one record are reported but never fail the gate
@@ -58,6 +60,10 @@ MS_KEYS = (
     "peak_hbm_mb",
 )
 ENV_KEYS = ("backend", "device_count", "jax_version", "smoke")
+# per-phase HLO pass kinds gated round over round (keep in sync with
+# analysis/hlo_census.py GATED_KINDS; convert/transpose counts are
+# reported in the record but move with benign layout choices)
+PHASE_GATE_KINDS = ("gather", "scatter", "sort", "cumsum", "all_to_all")
 
 
 def load_bench(path: str) -> Optional[Dict[str, Any]]:
@@ -141,9 +147,65 @@ def check_steady_state(new: Dict[str, Any]) -> int:
     return 0
 
 
+def check_phase_budget(old: Dict[str, Any], new: Dict[str, Any]) -> int:
+    """The PR 7 pass-budget gate, the static analogue of the recompile
+    gate: the bench record embeds the per-phase HLO pass census of the
+    headline step (``phase_budget.phases``: gather/scatter/sort/cumsum/
+    all-to-all passes per ``obs.scope`` phase). Two absolute checks and
+    one diff:
+
+    * a candidate whose census VIOLATES its own contracts (e.g. a dedup
+      pass compiled into the SparseSGD headline) fails outright;
+    * a candidate whose gated pass count GROWS in any phase both records
+      share fails — an extra gather/sort in the hot path is a regression
+      even before it shows up as milliseconds. Counts dropping, phases
+      disappearing, or brand-new phases are fine (pass cuts and new
+      instrumentation are the point).
+
+    Records without a ``phase_budget`` section (pre-PR-7) pass the diff.
+    """
+    failures = 0
+    nb = new.get("phase_budget")
+    if not isinstance(nb, dict):
+        if isinstance(old.get("phase_budget"), dict):
+            # the baseline proves the section used to exist: a candidate
+            # without one means the census crashed or was skipped, and a
+            # silent pass here would hide exactly the regressions the
+            # gate exists to catch
+            print("compare_bench: candidate record has no phase_budget "
+                  "section but the baseline does — the census failed or "
+                  "was skipped; the pass-budget gate cannot run",
+                  file=sys.stderr)
+            return 1
+        return 0  # both pre-PR-7 records: nothing to compare
+    for v in nb.get("violations") or []:
+        print(f"compare_bench: phase_budget contract violation in the "
+              f"candidate record: {v}", file=sys.stderr)
+        failures += 1
+    ob = old.get("phase_budget")
+    ophases = ob.get("phases") if isinstance(ob, dict) else None
+    nphases = nb.get("phases")
+    if not isinstance(ophases, dict) or not isinstance(nphases, dict):
+        return failures
+    for phase, orow in ophases.items():
+        nrow = nphases.get(phase)
+        if not isinstance(orow, dict) or not isinstance(nrow, dict):
+            continue
+        for kind in PHASE_GATE_KINDS:
+            ov, nv = orow.get(kind, 0) or 0, nrow.get(kind, 0) or 0
+            if nv > ov:
+                print(f"compare_bench: phase_budget REGRESSION: phase "
+                      f"{phase!r} {kind} passes {ov} -> {nv} — a new "
+                      "row-op pass entered the hot path",
+                      file=sys.stderr)
+                failures += 1
+    return failures
+
+
 def compare(old: Dict[str, Any], new: Dict[str, Any],
             threshold: float) -> int:
     steady_failures = check_steady_state(new)
+    steady_failures += check_phase_budget(old, new)
     regressions = 0
     rows = []
     for keys, higher_better in ((THROUGHPUT_KEYS, True), (MS_KEYS, False)):

@@ -1,0 +1,62 @@
+"""No ``jnp.unique``/``jnp.nonzero`` without ``size=`` in package code.
+
+The dynamic-shape family (``unique``, ``nonzero``, ``argwhere``,
+``flatnonzero``, ``unique_values``/``unique_counts``/...) returns a
+data-dependent shape. Under jit that either fails outright or — worse,
+via ``jax.ensure_compile_time_eval`` / host staging — silently retraces
+per distinct count, the exact recompile poison the steady-state gate
+exists to catch; ``size=`` pins the static capacity (the repo-wide
+convention: ``analysis/telemetry.py`` candidate extraction,
+``unique_ids_static``'s sort-based equivalent). Host-side numpy
+(``np.unique``) is untouched — this rule only matches the ``jnp`` /
+``jax.numpy`` spellings inside ``distributed_embeddings_tpu/``. A
+genuinely eager call site can annotate the line with
+``# unsized-ok: <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import Finding
+
+NAME = "unsized-unique"
+SCOPE = ("distributed_embeddings_tpu/**",)
+MARKER = "unsized-ok:"
+
+#: jnp callables whose output shape depends on the data unless size= pins it
+DYNAMIC_FNS = frozenset({
+    "unique", "unique_values", "unique_counts", "unique_inverse",
+    "unique_all", "nonzero", "flatnonzero", "argwhere",
+})
+
+
+def _is_jnp(node: ast.expr) -> bool:
+    """``jnp.foo`` or ``jax.numpy.foo`` (the package's two spellings)."""
+    if isinstance(node, ast.Name):
+        return node.id == "jnp"
+    return (isinstance(node, ast.Attribute) and node.attr == "numpy"
+            and isinstance(node.value, ast.Name) and node.value.id == "jax")
+
+
+def check(tree: ast.Module, path: str, src: str, ctx) -> list:
+    lines = src.splitlines()
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr in DYNAMIC_FNS
+                and _is_jnp(f.value)):
+            continue
+        if any(kw.arg == "size" for kw in node.keywords):
+            continue
+        if MARKER in lines[node.lineno - 1]:
+            continue
+        findings.append(Finding(
+            NAME, path, node.lineno,
+            f"jnp.{f.attr}() without size= — a data-dependent shape is a "
+            "TPU recompile/correctness hazard under jit; pin the static "
+            f"capacity with size= (or annotate '# {MARKER} <reason>' for "
+            "a genuinely eager call site)"))
+    return findings

@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Audit the compiled hybrid step's per-phase HLO pass budget on a CPU mesh.
+
+The jaxpr-level auditor (``tools/audit_step.py``) checks what we ASK the
+compiler for; this gate checks what the compiler EMITS. It builds the
+shared reference configurations (``tools/_profcommon.build_case`` — the
+same shapes the profile tools and the SPMD auditor use), compiles the
+hybrid train step abstractly on an N-virtual-device CPU mesh, parses the
+optimized HLO (``metadata.op_name`` carries the ``obs.scope`` phases),
+and enforces the declarative pass budgets of
+:mod:`distributed_embeddings_tpu.analysis.hlo_census`:
+
+* the ``dedup`` phase compiles to ZERO sort/segment-sum/scatter/gather
+  passes whenever the sparse optimizer declares ``needs_dedup=False``
+  (SparseSGD — the ROADMAP 3(a) pass cut), and is PRESENT (>= 1 sort)
+  for stateful optimizers on the dedup-regime ``bigvocab`` shapes;
+* at most 2 gather passes per (width, kind) lookup group (the packed
+  gather plus its lane-extract companion);
+* no float convert round-trips anywhere in the fp32 reference steps
+  (an f32->bf16->f32 squeeze inside a phase silently drops mantissa).
+
+Nothing executes on any backend — ``lower().compile()`` only.
+
+    python tools/hlo_audit.py --strict            # make verify's gate
+    python tools/hlo_audit.py --json report.json --config bigvocab
+    python tools/hlo_audit.py --markdown          # per-phase budget tables
+
+Exit codes: 0 clean; 1 violations found (only with ``--strict``);
+2 usable-environment failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+try:  # imported as tools.hlo_audit (tests)
+    from tools._profcommon import build_case, cpu_mesh, force_cpu  # noqa: F401
+except ImportError:  # run as a script: tools/ itself is sys.path[0]
+    from _profcommon import build_case, cpu_mesh, force_cpu  # noqa: F401
+
+
+def shared_contracts():
+    """Budgets every fp32 reference configuration must hold."""
+    from distributed_embeddings_tpu.analysis import PassBudget
+
+    return [
+        PassBudget("*lookup_*", "gather", max_passes=2, per_path=True,
+                   reason="one gather pass per (width, kind) lookup group "
+                          "(+1 for the packed lane extract)"),
+        PassBudget("*", "convert_roundtrip", max_passes=0,
+                   reason="float round-trip converts squeeze mantissa; the "
+                          "fp32 reference steps must have none"),
+    ]
+
+
+def census_case(name: str, world: int, batch: int, opt_name: str):
+    """Census one (config, optimizer) pair against its contracts."""
+    import optax
+
+    from distributed_embeddings_tpu.analysis import (
+        PassBudget, census_train_step, default_contracts)
+    from distributed_embeddings_tpu.parallel import SparseAdagrad, SparseSGD
+
+    opt = SparseSGD() if opt_name == "sgd" else SparseAdagrad()
+    de, cats, batch_tree, dense_params, loss_fn = build_case(
+        name, world, batch)
+    contracts = list(default_contracts(opt)) + shared_contracts()
+    if name == "bigvocab" and opt_name != "sgd":
+        # the dedup-regime shapes with a stateful optimizer: the pass must
+        # EXIST (its disappearance would mean duplicates silently corrupt
+        # the accumulator read-modify-write)
+        contracts.append(PassBudget(
+            "dedup", "sort", max_passes=8, min_passes=1,
+            reason="stateful optimizer on dedup-regime shapes must compile "
+                   "the sort-dedup pass"))
+    return census_train_step(
+        de, loss_fn, optax.sgd(0.5), opt, cats, batch_tree,
+        mesh=cpu_mesh(world), lr_schedule=0.3,
+        dense_params=dense_params, contracts=contracts,
+        label=f"{name}/world{world}/{opt_name}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--config",
+                    choices=("dense", "ragged", "row_sliced", "bigvocab",
+                             "all"),
+                    default="all")
+    ap.add_argument("--world", type=int, default=8,
+                    help="mesh positions (CPU virtual devices; default 8)")
+    ap.add_argument("--batch", type=int, default=16, help="global batch")
+    ap.add_argument("--sgd-dedup", action="store_true",
+                    help="audit the DETPU_SGD_DEDUP=1 A/B variant (forces "
+                         "the dedup pass back into the SGD build)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on any violation (the make verify gate)")
+    ap.add_argument("--markdown", action="store_true",
+                    help="print each case's per-phase budget table")
+    ap.add_argument("--json", metavar="PATH",
+                    help="dump the full reports as JSON (- for stdout)")
+    args = ap.parse_args(argv)
+
+    force_cpu(max(args.world, 1))
+    if args.sgd_dedup:
+        # unconditionally "1": preserving an inherited value would let an
+        # exported DETPU_SGD_DEDUP=0 silently audit the default build
+        # under the flag that promises the forced-dedup A/B variant
+        os.environ["DETPU_SGD_DEDUP"] = "1"
+    sys.path.insert(0, REPO)
+
+    # (config, optimizer) sweep: the tier-1 shapes under the stateful
+    # optimizer the SPMD auditor uses, plus the dedup-regime shapes under
+    # BOTH families — the SGD build must be dedup-free, the Adagrad build
+    # must not lose its dedup pass
+    if args.config == "all":
+        cases = [("dense", "adagrad"), ("ragged", "adagrad"),
+                 ("row_sliced", "adagrad"),
+                 ("bigvocab", "sgd"), ("bigvocab", "adagrad")]
+    elif args.config == "bigvocab":
+        cases = [("bigvocab", "sgd"), ("bigvocab", "adagrad")]
+    else:
+        cases = [(args.config, "adagrad")]
+
+    reports = []
+    failed = 0
+    for name, opt_name in cases:
+        try:
+            rep = census_case(name, args.world, args.batch, opt_name)
+        except Exception as e:  # noqa: BLE001 - report, then fail the gate
+            print(f"hlo_audit: {name}/{opt_name}: census errored: {e}",
+                  file=sys.stderr)
+            return 2
+        reports.append(rep)
+        status = "OK" if rep.ok else "FAIL"
+        print(f"hlo_audit: {rep.label}: {status} "
+              f"phases={len(rep.phases)} "
+              f"dedup_sort={rep.passes('dedup', 'sort')} "
+              f"dedup_scatter={rep.passes('dedup', 'scatter')} "
+              f"lookup_gathers={rep.passes('*lookup_*', 'gather')} "
+              f"a2a={rep.passes('*', 'all_to_all')}")
+        if args.markdown:
+            print(rep.markdown())
+        for v in rep.violations:
+            print(f"hlo_audit:   violation: {v}", file=sys.stderr)
+            failed += 1
+    if args.json:
+        payload = json.dumps([r.to_json() for r in reports], indent=2)
+        if args.json == "-":
+            print(payload)
+        else:
+            with open(args.json, "w", encoding="utf-8") as fh:
+                fh.write(payload + "\n")
+    if failed and args.strict:
+        print(f"hlo_audit: {failed} violation(s)", file=sys.stderr)
+        return 1
+    if not failed:
+        print(f"hlo_audit: OK ({len(reports)} case(s) hold their compiled "
+              "pass budgets)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
