@@ -4,8 +4,8 @@
 SHELL := /bin/bash
 
 .PHONY: all native test test-fast bench bench-diff clean pkg verify \
-        lint audit-step hlo-audit check-backend check-obs check-obs-report \
-        check-resilience check-reshard obs-report
+        lint plan-audit audit-step hlo-audit check-backend check-obs \
+        check-obs-report check-resilience check-reshard obs-report
 
 all: native
 
@@ -27,8 +27,8 @@ bench:
 # plus the static gates (detlint rules, the SPMD step auditor, the legacy
 # no-eager-backend shim), the observability gate, and the
 # preemption-recovery drill — run before shipping a round
-verify: lint audit-step hlo-audit check-backend check-obs check-obs-report \
-        check-resilience check-reshard
+verify: lint plan-audit audit-step hlo-audit check-backend check-obs \
+        check-obs-report check-resilience check-reshard
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -41,6 +41,14 @@ verify: lint audit-step hlo-audit check-backend check-obs check-obs-report \
 # host-fetch, named-scope-exchange, module-scope-jax (tools/detlint/)
 lint:
 	python -m tools.detlint
+
+# plan-time capacity auditor: prices every reference plan (incl. the real
+# Criteo-1TB vocab vector at world=16) backend-free — per-rank HBM, a2a
+# payload/step, scatter-cliff slabs — enforces the PlanContracts, checks
+# the byte model against analysis/memory.py, and self-drills two seeded
+# violations (over-HBM, past-cliff); analysis/plan_audit.py
+plan-audit:
+	env JAX_PLATFORMS=cpu python tools/plan_audit.py --strict
 
 # SPMD invariant auditor: traces the hybrid step abstractly on an
 # 8-virtual-device CPU mesh and enforces the communication contract

@@ -64,14 +64,11 @@ CRITEO_KAGGLE_SIZES = [
     8351593, 3194, 27, 14992, 5461306, 10, 5652, 2173, 4, 7046547, 18, 15,
     286181, 105, 142572,
 ]
-# Criteo-1TB (MLPerf DLRM) vocab sizes + the reference's "+1" convention
-# (``examples/dlrm/main.py:68-73`` loads model_size.json and adds 1). This is
-# the model behind BASELINE.md's 8xA100 numbers and the north-star target.
-CRITEO_1TB_SIZES = [s + 1 for s in [
-    39884406, 39043, 17289, 7420, 20263, 3, 7120, 1543, 63, 38532951,
-    2953546, 403346, 10, 2208, 11938, 155, 4, 976, 14, 39979771, 25641295,
-    39664984, 585935, 12972, 108, 36,
-]]
+# Criteo-1TB (MLPerf DLRM) vocab sizes: the model behind BASELINE.md's
+# 8xA100 numbers and the north-star target. Single-sourced from
+# tools/_profcommon so the bench, the plan-time capacity auditor
+# (tools/plan_audit.py), and the profile tools price the same vector.
+from tools._profcommon import CRITEO_1TB_SIZES
 CAP = 2_000_000
 BATCH = 65536
 # steps scanned per dispatch by each variant's loop driver (see run_dlrm)
@@ -716,6 +713,108 @@ def run_step_memory():
     }
 
 
+def run_plan_audit():
+    """Plan-time capacity model vs XLA's own accounting (ISSUE 8): the
+    headline capped-bf16 DLRM layout is priced twice — by
+    ``analysis/plan_audit.py``'s jax-free byte model and by the compiled
+    step's ``memory_analysis()`` argument bytes — and the record carries
+    the drift. ``tools/compare_bench.py`` fails a candidate whose drift
+    exceeds 15% (the predictor must stay validated, not decorative) or
+    whose plan violates its capacity contracts. The Criteo-1TB
+    deployment plan (world=16, bf16, column-sliced — the north-star
+    shape) is audited alongside, so its predicted per-rank HBM and
+    a2a-payload figures are versioned with every bench round."""
+    from distributed_embeddings_tpu.analysis import memory as dmem
+    from distributed_embeddings_tpu.analysis import plan_audit as pa
+    from distributed_embeddings_tpu.parallel import trainer as trainer_mod
+    from tools._profcommon import (CRITEO1TB_BATCH, CRITEO1TB_COL_SLICE,
+                                   CRITEO1TB_DIM, CRITEO1TB_WORLD)
+
+    table_sizes = [min(s, CAP) for s in CRITEO_KAGGLE_SIZES]
+    cfg = make_cfg(table_sizes, jnp.bfloat16)
+    de = DistributedEmbedding(cfg.embedding_configs(), world_size=1,
+                              compute_dtype=jnp.bfloat16)
+    dense = DLRMDense(cfg)
+
+    def loss_fn(dp, emb_outs, b):
+        n, y = b
+        return bce_with_logits(dense.apply(dp, n, emb_outs), y)
+
+    rng = np.random.default_rng(0)
+    num2 = jnp.asarray(rng.normal(size=(2, 13)), jnp.float32)
+    dense_params = dense.init(
+        jax.random.key(0), num2,
+        [jnp.zeros((2, cfg.embedding_dim), jnp.float32)
+         for _ in table_sizes])
+    cats = [jax.ShapeDtypeStruct((BATCH,), jnp.int32) for _ in table_sizes]
+    batch_tree = (jax.ShapeDtypeStruct((BATCH, 13), jnp.float32),
+                  jax.ShapeDtypeStruct((BATCH, 1), jnp.float32))
+    emb_opt = SparseSGD()
+    tx = optax.sgd(0.005)
+
+    # --- the jax-free prediction, contract-checked
+    rep = pa.audit_plan(de, BATCH, optimizer=emb_opt,
+                        param_dtype=jnp.bfloat16, cat_inputs=cats,
+                        label="bench_headline", contract=pa.default_contract())
+    pred_emb = sum(r.alloc_param_bytes + r.opt_state_bytes
+                   for r in rep.per_rank)
+
+    # --- what XLA says the same step's arguments weigh (abstract
+    # compile; nothing executes). Predicted arguments = the plan model's
+    # embedding bytes + eval_shape's non-embedding state + the inputs —
+    # so a drift isolates to the plan model's slab arithmetic.
+    state = jax.eval_shape(
+        lambda k, dp: trainer_mod.init_hybrid_state(
+            de, emb_opt, dp, tx, k, dtype=jnp.bfloat16),
+        jax.random.key(0), dense_params)
+    leaf = dmem._leaf_bytes
+    rest = leaf(state) - leaf(state.emb_params) - leaf(state.emb_opt_state)
+    input_bytes = leaf(cats) + leaf(batch_tree)
+    predicted_arg = pred_emb + rest + input_bytes
+    step = trainer_mod.make_hybrid_train_step(de, loss_fn, tx, emb_opt,
+                                              with_metrics=False,
+                                              nan_guard=False)
+    comp = dmem.compiled_step_report(step, (state, cats, batch_tree))
+    measured = comp.get("argument_bytes")
+    drift = (None if not measured
+             else (predicted_arg - measured) / measured)
+
+    # --- the north-star plan, audited at real shapes (pure arithmetic)
+    from distributed_embeddings_tpu.parallel.strategy import (
+        DistEmbeddingStrategy)
+    c1tb = DistEmbeddingStrategy(
+        [{"input_dim": int(s), "output_dim": CRITEO1TB_DIM,
+          "combiner": None} for s in CRITEO_1TB_SIZES],
+        CRITEO1TB_WORLD, strategy="comm_balanced",
+        column_slice_threshold=None if SMOKE else CRITEO1TB_COL_SLICE)
+    c1tb_rep = pa.audit_plan(
+        c1tb, CRITEO1TB_BATCH, optimizer="sgd", param_dtype=jnp.bfloat16,
+        dp_input=False, label="criteo1tb_v5e16",
+        contract=None if SMOKE else pa.default_contract())
+
+    def mb(x):
+        return None if x is None else round(x / 1e6, 2)
+
+    return {
+        "predicted_argument_mb": mb(predicted_arg),
+        "measured_argument_mb": mb(measured),
+        "byte_drift_frac": None if drift is None else round(drift, 4),
+        "emb_predicted_mb": mb(pred_emb),
+        "groups": rep.n_groups,
+        "s_max": rep.s_max,
+        "violations": list(rep.violations),
+        "compile_error": comp.get("error"),
+        "criteo1tb": {
+            "max_rank_gb": round(c1tb_rep.max_rank_bytes / 1024**3, 3),
+            "total_a2a_mb_per_step": round(
+                c1tb_rep.total_a2a_bytes_per_step / 1e6, 2),
+            "imbalance_ratio": round(c1tb_rep.imbalance_ratio, 3),
+            "groups": c1tb_rep.n_groups,
+            "violations": list(c1tb_rep.violations),
+        },
+    }
+
+
 def run_phase_budget():
     """Static per-phase HLO pass census of the headline step (ROADMAP
     3(a)): the capped bf16 DLRM step is abstractly compiled and its
@@ -1111,6 +1210,12 @@ def main():
             # lifted so compare_bench gates per-step peak HBM growth
             # (>10% fails) like any other headline metric
             out["peak_hbm_mb"] = stepmem["peak_hbm_mb"]
+    pau = _guard("plan_audit", run_plan_audit)
+    if pau is not None:
+        # the capacity model rides the record so tools/compare_bench.py
+        # can fail a candidate whose predicted-vs-measured byte drift
+        # exceeds 15% or whose plan violates its capacity contracts
+        out["plan_audit"] = pau
     pb = _guard("phase_budget", run_phase_budget)
     if pb is not None:
         # the census rides the record so tools/compare_bench.py can fail a

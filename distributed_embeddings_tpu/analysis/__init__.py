@@ -6,13 +6,17 @@ backward — and this package verifies it by abstract interpretation
 (jaxpr/StableHLO inspection, no backend execution) instead of by reading
 throughput numbers after the fact. See :mod:`.audit`.
 
-Three sibling layers complete the observatory: :mod:`.hlo_census` (the
+Four sibling layers complete the observatory: :mod:`.hlo_census` (the
 per-phase op census of the *optimized HLO* — gather/scatter/sort/convert
 pass budgets per ``obs.scope`` phase, enforced by ``tools/hlo_audit.py``),
 :mod:`.telemetry` (on-device jit-carried access telemetry — per-table
-hot-row sketches, per-rank load accounting) and :mod:`.memory` (static
+hot-row sketches, per-rank load accounting), :mod:`.memory` (static
 per-table/slab HBM budgets plus compiled-step memory/FLOP reports via
-abstract lowering). Fused into one run report by ``tools/obs_report.py``.
+abstract lowering) and :mod:`.plan_audit` (the gate BEFORE all of the
+above: a backend-free byte/comms model of a placement plan, enforced as
+:class:`~.plan_audit.PlanContract` s by ``tools/plan_audit.py`` — incl.
+the chip capacity registry). Fused into one run report by
+``tools/obs_report.py``.
 """
 
 from .audit import (
@@ -37,6 +41,18 @@ from .memory import (
     compiled_step_report,
     step_memory_report,
     table_memory_report,
+)
+from .plan_audit import (
+    CHIP_SPECS,
+    ChipSpec,
+    PlanAuditError,
+    PlanContract,
+    PlanReport,
+    audit_plan,
+    audit_plan_spec,
+    compare_with_memory,
+    default_contract,
+    rank_strategies,
 )
 from .telemetry import (
     TelemetryConfig,
@@ -71,4 +87,14 @@ __all__ = [
     "census_train_step",
     "dedup_zero_contracts",
     "default_contracts",
+    "CHIP_SPECS",
+    "ChipSpec",
+    "PlanAuditError",
+    "PlanContract",
+    "PlanReport",
+    "audit_plan",
+    "audit_plan_spec",
+    "compare_with_memory",
+    "default_contract",
+    "rank_strategies",
 ]

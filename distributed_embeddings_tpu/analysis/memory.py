@@ -11,7 +11,10 @@ eyeballing allocator logs. This module answers both *abstractly*:
   slab from the strategy alone — parameter bytes, optimizer-state bytes
   (``jax.eval_shape`` over the sparse optimizer's ``init``, so any
   optimizer prices itself), lane/row padding overhead, per-rank live
-  bytes. Pure metadata; no arrays are materialized.
+  bytes. Pure metadata; no arrays are materialized. Since PR 8 this is
+  also the *calibration target* of :mod:`.plan_audit`'s jax-free byte
+  model (``tools/plan_audit.py --strict`` requires the two to agree),
+  rather than the only source of capacity numbers.
 * :func:`compiled_step_report` lowers + compiles a jitted step (CPU-safe
   — compilation never executes anything) and reads XLA's own
   ``memory_analysis()`` / ``cost_analysis()``: argument/output/temp/
@@ -153,6 +156,13 @@ def table_memory_report(de, emb_optimizer=None,
                              if alloc_total else 0.0),
             "opt_state_bytes": opt_total,
             "opt_state_error": opt_error,
+            # the slab layout is rank-uniform ([world, cap, w] stacked
+            # tables), so per-rank allocated/optimizer shares are exact
+            # divisions — the figures analysis.plan_audit predicts
+            # jax-free and calibrates against these
+            "param_bytes_allocated_per_rank": alloc_total // world,
+            "opt_state_bytes_per_rank": (None if opt_total is None
+                                         else opt_total // world),
         },
     }
 

@@ -1,0 +1,868 @@
+"""Plan-time capacity & cost auditor — static HBM/comms contracts.
+
+The repo has two static gates already: :mod:`.audit` (PR 4) checks the
+jaxpr we ASK the compiler for and :mod:`.hlo_census` (PR 7) checks what
+XLA EMITS. Both need a traceable step, i.e. a built
+:class:`~..parallel.dist_embedding.DistributedEmbedding` and a jax
+import. This module is the gate that runs *before either*: a pure-host
+analytic model of what a :class:`~..parallel.strategy.
+DistEmbeddingStrategy` plan will cost once executed — per-rank
+parameter + optimizer + exchange-buffer bytes, per-step all-to-all
+payload bytes, padded-group shape count (the recompile surface), apply-
+scatter slab sizes against the measured cliff, placement imbalance —
+with nothing but integer arithmetic over the plan. GSPMD-style systems
+validate placements before touching a pod (SNIPPETS.md [2]'s "8-chip →
+6000-chip without changing application code"); this is that validation
+for the 26-table / 188M-row Criteo-1TB shapes the ≥2M samples/s
+north star is projected at.
+
+The model is *calibrated*, not parallel-universe arithmetic:
+
+* slab geometry (lane packing, row alignment, per-width physical
+  capacity) mirrors ``DistributedEmbedding.__init__`` /
+  ``ops/packed_slab.py`` exactly and is pinned to them by test;
+* exchange layout (``l_max``/``s_max``/groups) comes from the
+  executor's OWN plan builder (:func:`~..parallel.plan.build_plan`,
+  numpy-only — no jax executes);
+* per-step payload bytes use the same ``(world-1) * padded_block``
+  formula ``DistributedEmbedding.step_metrics`` reports on device, so
+  the prediction is checkable against the measured ``*_a2a_bytes``
+  step metrics;
+* parameter/optimizer byte totals are cross-checked against
+  :func:`.memory.table_memory_report`'s ``eval_shape`` accounting
+  (which becomes the calibration target rather than the only source)
+  by :func:`compare_with_memory` — ``tools/plan_audit.py --strict``
+  enforces agreement.
+
+On top sit declarative :class:`PlanContract` s (max per-rank HBM, max
+a2a bytes/step, zero slabs past the scatter cliff, every rank owns a
+table, padded-group ceiling), enforced by ``tools/plan_audit.py
+--strict`` inside ``make verify`` — including a ``criteo1tb`` case with
+the real vocab vector — and consumed by planners through
+:meth:`DistEmbeddingStrategy.predicted_cost` / :func:`rank_strategies`
+to rank candidate plans by predicted cost before anything is built.
+
+This module is also the repo's **capacity registry**: chip capability
+numbers (HBM bytes, ICI bandwidth, peak FLOPs) and measured byte
+thresholds (the 2.7→8.65 GB scatter cliff) live HERE as named
+constants. The detlint rule ``hardcoded-capacity`` forbids capacity
+literals elsewhere in the package — a device count or HBM size inlined
+at a call site drifts silently when hardware assumptions change.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# --------------------------------------------------------------------------
+# capacity registry (the single home for hardware capability numbers;
+# everything else in the package must reference these — detlint rule
+# `hardcoded-capacity`)
+# --------------------------------------------------------------------------
+
+#: TPU vector lane count — the packed-slab layout constant
+#: (mirrors ``ops/packed_slab.LANES``; agreement is test-pinned so the
+#: jax-free arithmetic here cannot drift from the executor's).
+LANES = 128
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSpec:
+    """Capability numbers of one accelerator generation.
+
+    ``hbm_headroom`` is the fraction of HBM a plan may budget: XLA
+    reserves workspace, the step needs transients (exchange buffers are
+    priced separately but fusions/temps are not), and a plan sized to
+    100% of HBM OOMs on the first compile with different flags.
+    """
+
+    name: str
+    hbm_bytes: int
+    hbm_gbps: float
+    ici_eff_gbps: float
+    bf16_peak_flops: float
+    hbm_headroom: float = 0.90
+
+
+#: Known chips. v5e (v5 lite): 16 GiB HBM at 819 GB/s, 197 TFLOP/s bf16
+#: peak, ~100 GB/s effective per-chip all-to-all bandwidth over ICI
+#: (2D torus, 4x 400 Gbps links; conservative effective figure — the
+#: same numbers bench.py's v5e-16 budget uses).
+CHIP_SPECS: Dict[str, ChipSpec] = {
+    "v5e": ChipSpec("v5e", hbm_bytes=16 * 1024**3, hbm_gbps=819.0,
+                    ici_eff_gbps=100.0, bf16_peak_flops=197e12),
+}
+
+#: The measured apply-scatter rate cliff (docs/perf_tpu.md, VERDICT.md
+#: Weak #3): a single uncapped scatter into a 2.7 GB slab ran at 43 ms
+#: while the same op into an 8.65 GB slab took 70 ms — the cliff lies
+#: inside that bracket. Slabs at or past the upper bound are flagged as
+#: contract violations; slabs inside the bracket are reported as
+#: "cliff_band" (exposed, but not proven slow).
+SCATTER_CLIFF_SAFE_BYTES = 2_700_000_000
+SCATTER_CLIFF_BYTES = 8_650_000_000
+
+#: Default ceiling on padded (width, kind, hotness) group shapes per
+#: plan. Each group is one statically-shaped exchange region — the
+#: compiled program is O(#groups) heavy ops, and every distinct
+#: (encodings, batch) signature compiles once; the zoo-scale invariant
+#: tests pin <= 12 groups at 2002 tables, so a plan past this ceiling
+#: has lost the rank-uniform layout property.
+DEFAULT_MAX_GROUPS = 16
+
+
+# --------------------------------------------------------------------------
+# jax-free mirrors of the packed-slab arithmetic (ops/packed_slab.py);
+# the parity test in tests/test_plan_audit.py pins these to the real ones
+# --------------------------------------------------------------------------
+
+
+def _pack_factor(width: int) -> int:
+    return max(1, LANES // int(width))
+
+
+def _phys_width(width: int) -> int:
+    return LANES if _pack_factor(width) > 1 else int(width)
+
+
+def _align_rows(rows: int, width: int) -> int:
+    p = _pack_factor(width)
+    return -(-int(rows) // p) * p
+
+
+_DTYPE_BYTES = {
+    "bool": 1, "int8": 1, "uint8": 1, "float8_e4m3fn": 1, "float8_e5m2": 1,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "float64": 8, "int64": 8, "uint64": 8,
+}
+
+
+def _dtype_name(dtype) -> str:
+    name = getattr(dtype, "__name__", None)
+    if name:
+        return name
+    try:
+        return str(np.dtype(dtype))
+    except TypeError:
+        return str(dtype)
+
+
+def _dtype_bytes(dtype) -> int:
+    """Itemsize of a dtype-like without importing jax (``np.dtype`` knows
+    bfloat16 only when ml_dtypes is registered, so the extension names
+    are table-driven)."""
+    name = _dtype_name(dtype)
+    if name in _DTYPE_BYTES:
+        return _DTYPE_BYTES[name]
+    return int(np.dtype(dtype).itemsize)
+
+
+# --------------------------------------------------------------------------
+# optimizer state model (calibrated against eval_shape over the real
+# optimizers' init by compare_with_memory)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerModel:
+    """Byte model of one sparse slab optimizer: ``slots`` whole-slab
+    state copies in the slab dtype (SGD 0, Adagrad/Momentum 1, Adam 2)
+    plus ``aux_bytes_per_slab`` per-rank bookkeeping (Adam's ``[.., 1,
+    1]`` f32 step count)."""
+
+    name: str
+    slots: int
+    aux_bytes_per_slab: int = 0
+
+
+OPTIMIZER_MODELS: Dict[str, OptimizerModel] = {
+    "sgd": OptimizerModel("sgd", 0),
+    "adagrad": OptimizerModel("adagrad", 1),
+    "momentum": OptimizerModel("momentum", 1),
+    "adam": OptimizerModel("adam", 2, aux_bytes_per_slab=4),
+}
+
+
+def optimizer_model(optimizer) -> OptimizerModel:
+    """Resolve an optimizer argument — a registry name, an
+    :class:`OptimizerModel`, or a ``Sparse*`` instance/class (matched by
+    class name) — to its byte model."""
+    if isinstance(optimizer, OptimizerModel):
+        return optimizer
+    if isinstance(optimizer, str):
+        try:
+            return OPTIMIZER_MODELS[optimizer.lower()]
+        except KeyError:
+            raise ValueError(
+                f"unknown optimizer {optimizer!r} (have: "
+                f"{', '.join(sorted(OPTIMIZER_MODELS))})") from None
+    name = type(optimizer).__name__ if not isinstance(optimizer, type) \
+        else optimizer.__name__
+    key = name.lower().removeprefix("sparse")
+    if key in OPTIMIZER_MODELS:
+        return OPTIMIZER_MODELS[key]
+    raise ValueError(
+        f"cannot derive a byte model from optimizer {name!r}; pass an "
+        "OptimizerModel or a registry name "
+        f"({', '.join(sorted(OPTIMIZER_MODELS))})")
+
+
+# --------------------------------------------------------------------------
+# slab geometry from the strategy alone (mirror of
+# DistributedEmbedding.__init__'s width grouping; test-pinned)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SlabGeometry:
+    """Physical slab layout a strategy implies: per width the packed
+    ``[world, phys_cap, phys_w]`` stacked-table shape every rank
+    allocates, plus each local table's logical row offset."""
+
+    widths: Tuple[int, ...]
+    row_offsets_list: Tuple[Tuple[int, ...], ...]
+    rows_cap: Dict[int, int]
+    phys_cap: Dict[int, int]
+    phys_w: Dict[int, int]
+
+    def rank_param_bytes(self, param_bytes: int) -> int:
+        """Allocated slab bytes per rank (identical on every rank: the
+        layout is SPMD-uniform, padding rows absorb imbalance)."""
+        return sum(self.phys_cap[w] * self.phys_w[w] * param_bytes
+                   for w in self.widths)
+
+
+def slab_geometry(strategy) -> SlabGeometry:
+    """Derive the packed slab geometry from a planned strategy — the
+    same width grouping / row alignment / max-over-ranks capacity
+    computation ``DistributedEmbedding.__init__`` performs, without
+    building the layer (or importing jax)."""
+    widths = sorted({int(c["output_dim"])
+                     for cfgs in strategy.local_configs_list
+                     for c in cfgs})
+    row_offsets_list: List[Tuple[int, ...]] = []
+    per_rank_rows: List[Dict[int, int]] = []
+    for cfgs in strategy.local_configs_list:
+        used = {w: 0 for w in widths}
+        offsets = []
+        for c in cfgs:
+            w = int(c["output_dim"])
+            offsets.append(used[w])
+            used[w] += _align_rows(int(c["input_dim"]), w)
+        row_offsets_list.append(tuple(offsets))
+        per_rank_rows.append(used)
+    rows_cap = {w: max(max(max(r[w] for r in per_rank_rows), 1),
+                       _pack_factor(w)) for w in widths}
+    rows_cap = {w: _align_rows(rows_cap[w], w) for w in widths}
+    phys_cap = {w: rows_cap[w] // _pack_factor(w) for w in widths}
+    phys_w = {w: _phys_width(w) for w in widths}
+    return SlabGeometry(widths=tuple(widths),
+                        row_offsets_list=tuple(row_offsets_list),
+                        rows_cap=rows_cap, phys_cap=phys_cap, phys_w=phys_w)
+
+
+def encodings_from_inputs(strategy, cat_inputs, world: int
+                          ) -> Tuple[List[tuple], int]:
+    """Derive the exchange-plan encodings and the per-shard batch from
+    abstract (or concrete) GLOBAL inputs — the shapes a caller hands the
+    distributed step. Dense arrays map like
+    ``DistributedEmbedding._dense_enc`` (leading dim = global batch);
+    Ragged-likes (anything with ``values``/``row_splits``) carry their
+    per-shard static capacity as ``values.shape[0] // world``.
+    """
+    encs: List[tuple] = []
+    b_local: Optional[int] = None
+
+    def see_batch(gb: int, what: str) -> None:
+        nonlocal b_local
+        if gb % world:
+            raise ValueError(
+                f"{what}: global batch {gb} not divisible by world {world}")
+        lb = gb // world
+        if b_local is None:
+            b_local = lb
+        elif b_local != lb:
+            raise ValueError(
+                f"{what}: per-shard batch {lb} disagrees with {b_local}")
+
+    for i, inp in enumerate(cat_inputs):
+        tid = strategy.input_table_map[i]
+        comb = strategy.global_configs[tid].get("combiner")
+        if hasattr(inp, "row_splits"):
+            cap = int(inp.values.shape[0])
+            nsplit = int(inp.row_splits.shape[0])
+            if cap % world or nsplit % world:
+                raise ValueError(
+                    f"input {i}: ragged shapes {(cap, nsplit)} not "
+                    f"divisible by world {world}")
+            see_batch(nsplit - world, f"input {i}")
+            kind = "rw" if getattr(inp, "weights", None) is not None else "r"
+            encs.append((kind, cap // world))
+            continue
+        shape = tuple(int(d) for d in inp.shape)
+        if not shape:
+            raise ValueError(f"input {i}: scalar inputs are not routable")
+        see_batch(shape[0], f"input {i}")
+        dims = shape[1:]
+        if comb:
+            h = dims[-1] if dims else 1
+            ns = int(np.prod(dims[:-1], dtype=np.int64)) if len(dims) > 1 \
+                else 1
+            encs.append(("d", h, ns))
+        else:
+            ns = int(np.prod(dims, dtype=np.int64)) if dims else 1
+            encs.append(("d", 1, ns))
+    if b_local is None:
+        raise ValueError("no inputs to derive a batch from")
+    return encs, b_local
+
+
+# --------------------------------------------------------------------------
+# the report
+# --------------------------------------------------------------------------
+
+
+def _gb(x: float) -> float:
+    return x / 1024**3
+
+
+@dataclasses.dataclass
+class RankBudget:
+    """Predicted steady-state bytes of one rank."""
+
+    rank: int
+    tables: int
+    live_param_bytes: int     # logical rows * width * itemsize placed here
+    alloc_param_bytes: int    # the rank-uniform packed slab share
+    opt_state_bytes: int
+    a2a_buffer_bytes: int     # id block + fwd/bwd activation blocks
+    total_bytes: int
+    hbm_frac: float           # total / chip HBM
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class SlabBudget:
+    """One width slab's per-rank apply-scatter target."""
+
+    width: int
+    phys_rows: int
+    phys_width: int
+    rank_bytes: int
+    cliff: str                # "sub_cliff" | "cliff_band" | "past_cliff"
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class PlanReport:
+    """Everything the static model predicts about one plan at one
+    (batch, optimizer, dtype) configuration, plus any contract
+    violations. All byte figures are PER RANK unless suffixed
+    ``_global``; a2a payloads are per rank per step (bytes leaving the
+    chip — the same convention as the on-device ``*_a2a_bytes`` step
+    metrics, so predictions are directly checkable against telemetry).
+    """
+
+    label: str
+    chip: str
+    world: int
+    strategy: str
+    dp_input: bool
+    global_batch: int
+    local_batch: int
+    param_dtype: str
+    comm_dtype: str
+    optimizer: str
+    n_tables: int
+    n_sliced_tables: int
+    n_groups: int             # padded-group shape count (recompile surface)
+    l_max: int
+    s_max: int
+    groups: List[Dict[str, Any]]
+    per_rank: List[RankBudget]
+    slabs: List[SlabBudget]
+    id_a2a_bytes_per_step: int
+    out_a2a_bytes_per_step: int
+    grad_a2a_bytes_per_step: int
+    total_a2a_bytes_per_step: int
+    imbalance_ratio: float
+    out_pad_frac: float       # dead-column fraction of the padded exchange
+    violations: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    @property
+    def max_rank_bytes(self) -> int:
+        return max(r.total_bytes for r in self.per_rank)
+
+    def to_json(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        return d
+
+    def raise_on_violations(self) -> None:
+        if self.violations:
+            raise PlanAuditError(
+                f"{self.label}: {len(self.violations)} plan-contract "
+                "violation(s):\n  " + "\n  ".join(self.violations))
+
+    def markdown(self) -> str:
+        """Per-rank budget table + slab/cliff table, for docs and CLI."""
+        lines = [
+            f"### plan audit: {self.label}",
+            "",
+            f"chip {self.chip} · world {self.world} · strategy "
+            f"{self.strategy} · batch {self.global_batch} (local "
+            f"{self.local_batch}) · {self.param_dtype} params · "
+            f"{self.optimizer} · {'dp' if self.dp_input else 'mp'} input",
+            "",
+            f"groups {self.n_groups} · l_max {self.l_max} · s_max "
+            f"{self.s_max} · pad {self.out_pad_frac:.1%} · imbalance "
+            f"{self.imbalance_ratio:.2f} · a2a/step "
+            f"{self.total_a2a_bytes_per_step / 1e6:.2f} MB/rank",
+            "",
+            "| rank | tables | live GB | alloc GB | opt GB | a2a buf GB "
+            "| total GB | HBM frac |",
+            "|---:|---:|---:|---:|---:|---:|---:|---:|",
+        ]
+        for r in self.per_rank:
+            lines.append(
+                f"| {r.rank} | {r.tables} | {_gb(r.live_param_bytes):.3f} "
+                f"| {_gb(r.alloc_param_bytes):.3f} "
+                f"| {_gb(r.opt_state_bytes):.3f} "
+                f"| {_gb(r.a2a_buffer_bytes):.3f} "
+                f"| {_gb(r.total_bytes):.3f} | {r.hbm_frac:.1%} |")
+        lines += ["", "| slab | phys shape | rank GB | cliff |",
+                  "|---|---|---:|---|"]
+        for s in self.slabs:
+            lines.append(
+                f"| w{s.width} | [{s.phys_rows}, {s.phys_width}] "
+                f"| {_gb(s.rank_bytes):.3f} | {s.cliff} |")
+        if self.violations:
+            lines += ["", "violations:"] + [f"* {v}" for v in self.violations]
+        return "\n".join(lines)
+
+
+class PlanAuditError(RuntimeError):
+    """Raised by :meth:`PlanReport.raise_on_violations` in strict use."""
+
+
+# --------------------------------------------------------------------------
+# contracts
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanContract:
+    """Declarative limits a plan must satisfy before it is worth
+    building. ``None`` fields are unchecked; :func:`default_contract`
+    fills the HBM limit from the chip registry. Violation messages name
+    the offending rank / slab so the fix (re-balance, slice, shrink) is
+    actionable without re-deriving the report."""
+
+    max_rank_bytes: Optional[int] = None
+    max_a2a_bytes_per_step: Optional[int] = None
+    max_groups: Optional[int] = DEFAULT_MAX_GROUPS
+    forbid_cliff_slabs: bool = True
+    require_every_rank_owns_a_table: bool = True
+    reason: str = ""
+
+
+def default_contract(chip: str = "v5e") -> PlanContract:
+    """The make-verify contract: fit the chip's usable HBM, keep every
+    rank populated, no apply slab past the measured scatter cliff,
+    padded-group count within the zoo-scale invariant."""
+    spec = CHIP_SPECS[chip]
+    return PlanContract(
+        max_rank_bytes=int(spec.hbm_bytes * spec.hbm_headroom),
+        reason=f"fit {spec.name} ({_gb(spec.hbm_bytes):.0f} GiB HBM at "
+               f"{spec.hbm_headroom:.0%} headroom)")
+
+
+def check_contract(report: PlanReport, contract: PlanContract,
+                   strategy=None) -> List[str]:
+    """Evaluate one contract against a report; returns violation strings
+    (empty = clean). Also appends them to ``report.violations``."""
+    out: List[str] = []
+    if contract.require_every_rank_owns_a_table and strategy is not None:
+        empty = [r for r, tids in enumerate(strategy.table_ids_list)
+                 if not tids]
+        if empty:
+            out.append(
+                f"rank(s) {empty} own no table slice (world "
+                f"{report.world} > {report.n_sliced_tables} sliced tables"
+                " — DistributedEmbedding refuses such plans; shrink the "
+                "world or slice the big tables)")
+    if contract.max_rank_bytes is not None:
+        for r in report.per_rank:
+            if r.total_bytes > contract.max_rank_bytes:
+                out.append(
+                    f"rank {r.rank}: predicted {_gb(r.total_bytes):.2f} GB "
+                    f"(params {_gb(r.alloc_param_bytes):.2f} + opt "
+                    f"{_gb(r.opt_state_bytes):.2f} + a2a buffers "
+                    f"{_gb(r.a2a_buffer_bytes):.2f}) exceeds the per-rank "
+                    f"HBM contract {_gb(contract.max_rank_bytes):.2f} GB"
+                    f" ({contract.reason or report.chip})")
+    if contract.max_a2a_bytes_per_step is not None and \
+            report.total_a2a_bytes_per_step > contract.max_a2a_bytes_per_step:
+        out.append(
+            f"per-rank a2a payload {report.total_a2a_bytes_per_step / 1e6:.1f}"
+            f" MB/step exceeds the contract "
+            f"{contract.max_a2a_bytes_per_step / 1e6:.1f} MB/step "
+            f"(id {report.id_a2a_bytes_per_step / 1e6:.1f} + out "
+            f"{report.out_a2a_bytes_per_step / 1e6:.1f} + grad "
+            f"{report.grad_a2a_bytes_per_step / 1e6:.1f})")
+    if contract.max_groups is not None and \
+            report.n_groups > contract.max_groups:
+        out.append(
+            f"{report.n_groups} padded group shapes exceed the ceiling "
+            f"{contract.max_groups} — the rank-uniform O(#groups) layout "
+            "property is lost (compile surface grows with table "
+            "heterogeneity)")
+    if contract.forbid_cliff_slabs:
+        for s in report.slabs:
+            if s.cliff == "past_cliff":
+                out.append(
+                    f"slab w{s.width}: per-rank apply-scatter target "
+                    f"{_gb(s.rank_bytes):.2f} GB is past the measured "
+                    f"scatter cliff (>= "
+                    f"{SCATTER_CLIFF_BYTES / 1e9:.2f} GB: 43→70 ms apply, "
+                    "docs/perf_tpu.md) — split it with "
+                    "column_slice_threshold or spread over more ranks")
+    report.violations.extend(out)
+    return out
+
+
+# --------------------------------------------------------------------------
+# the audit
+# --------------------------------------------------------------------------
+
+
+def audit_plan(target,
+               global_batch: int,
+               *,
+               optimizer="sgd",
+               param_dtype="float32",
+               comm_dtype=None,
+               id_dtype_bytes: int = 4,
+               encodings: Optional[Sequence[tuple]] = None,
+               cat_inputs: Optional[Sequence[Any]] = None,
+               dp_input: Optional[bool] = None,
+               chip: str = "v5e",
+               label: Optional[str] = None,
+               contract: Optional[PlanContract] = None) -> PlanReport:
+    """Price a plan without building it.
+
+    Args:
+      target: a planned :class:`~..parallel.strategy.
+        DistEmbeddingStrategy` or a built ``DistributedEmbedding`` (its
+        strategy, ``dp_input`` and ``compute_dtype`` become defaults).
+      global_batch: global batch size (divided over ``world`` ranks).
+      optimizer: registry name (``sgd|adagrad|momentum|adam``), a
+        ``Sparse*`` optimizer instance, or an :class:`OptimizerModel`.
+      param_dtype / comm_dtype: slab dtype and exchanged-activation
+        dtype (``None`` comm = the param dtype, matching the executor's
+        ``compute_dtype=None`` default).
+      encodings: explicit per-input exchange encodings (the
+        ``("d", hot[, nslots])`` / ``("r"|"rw", cap)`` tuples of
+        ``parallel/plan.py``). Defaults to hotness-1 dense for every
+        input, or is derived from ``cat_inputs`` (global abstract/
+        concrete arrays or Ragged-likes) when given.
+      dp_input: whether the id all-to-all runs (``False`` = mp input,
+        id exchange skipped — its payload prices at zero).
+      contract: checked into ``report.violations`` when given
+        (:func:`default_contract` is NOT applied implicitly — an audit
+        is a report first, a gate only when asked).
+
+    Nothing executes and nothing is materialized: the heaviest object
+    built is the executor's numpy plan tensors (``[world, n]`` per
+    group).
+    """
+    from ..parallel import plan as plan_mod  # numpy-only plan builder
+
+    # a strategy exposes local_configs_list itself; a DistributedEmbedding
+    # wraps one under .strategy (which on the strategy itself is the NAME)
+    strategy = (target if hasattr(target, "local_configs_list")
+                else target.strategy)
+    if dp_input is None:
+        dp_input = bool(getattr(target, "dp_input", True))
+    if comm_dtype is None:
+        comm_dtype = getattr(target, "compute_dtype", None) or param_dtype
+    world = int(strategy.world_size)
+    p_isz = _dtype_bytes(param_dtype)
+    c_isz = _dtype_bytes(comm_dtype)
+    model = optimizer_model(optimizer)
+
+    if encodings is not None:
+        encs = [tuple(e) for e in encodings]
+        if global_batch % world:
+            raise ValueError(
+                f"global_batch {global_batch} not divisible by world {world}")
+        b_local = global_batch // world
+    elif cat_inputs is not None:
+        encs, b_local = encodings_from_inputs(strategy, cat_inputs, world)
+        if b_local * world != int(global_batch):
+            raise ValueError(
+                f"cat_inputs imply global batch {b_local * world}, "
+                f"got global_batch={global_batch}")
+    else:
+        encs = [("d", 1)] * len(strategy.input_table_map)
+        if global_batch % world:
+            raise ValueError(
+                f"global_batch {global_batch} not divisible by world {world}")
+        b_local = global_batch // world
+
+    geom = slab_geometry(strategy)
+    plan = plan_mod.build_plan(strategy, [list(o) for o in
+                                          geom.row_offsets_list],
+                               encs, b_local)
+
+    alloc_rank = geom.rank_param_bytes(p_isz)
+    opt_rank = (model.slots * alloc_rank
+                + model.aux_bytes_per_slab * len(geom.widths))
+
+    # transient exchange buffers a step holds per rank: the id block
+    # send+recv pair ([world, l_max] ids each; mp input holds one packed
+    # block instead of a send/recv pair) and the output exchange's
+    # forward send+recv pair ([world, b, s_max] activations; the
+    # backward cotangent exchange reuses the same shapes after the
+    # forward pair is dead, so it is not double-counted)
+    id_blocks = 1 if not dp_input else 2
+    a2a_buf = (id_blocks * world * plan.l_max * id_dtype_bytes
+               + 2 * world * b_local * plan.s_max * c_isz)
+
+    live_rank = [0] * world
+    tables_rank = [0] * world
+    for r, cfgs in enumerate(strategy.local_configs_list):
+        tables_rank[r] = len(cfgs)
+        for c in cfgs:
+            live_rank[r] += int(c["input_dim"]) * int(c["output_dim"]) * p_isz
+
+    spec = CHIP_SPECS[chip]
+    per_rank = []
+    for r in range(world):
+        total = alloc_rank + opt_rank + a2a_buf
+        per_rank.append(RankBudget(
+            rank=r, tables=tables_rank[r],
+            live_param_bytes=live_rank[r],
+            alloc_param_bytes=alloc_rank,
+            opt_state_bytes=opt_rank,
+            a2a_buffer_bytes=a2a_buf,
+            total_bytes=total,
+            hbm_frac=total / spec.hbm_bytes))
+
+    slabs = []
+    for w in geom.widths:
+        rb = geom.phys_cap[w] * geom.phys_w[w] * p_isz
+        cliff = ("past_cliff" if rb >= SCATTER_CLIFF_BYTES
+                 else "cliff_band" if rb > SCATTER_CLIFF_SAFE_BYTES
+                 else "sub_cliff")
+        slabs.append(SlabBudget(
+            width=w, phys_rows=geom.phys_cap[w], phys_width=geom.phys_w[w],
+            rank_bytes=rb, cliff=cliff))
+
+    # per-step off-chip payloads — the exact step_metrics formulas, so
+    # the prediction is checkable against the on-device *_a2a_bytes
+    off = max(world - 1, 0)
+    id_a2a = off * plan.l_max * id_dtype_bytes if dp_input else 0
+    out_a2a = off * b_local * plan.s_max * c_isz
+    live_cols = sum(plan.out_width(inst) for inst in plan.instances)
+    pad_frac = (1.0 - live_cols / (world * plan.s_max)
+                if plan.s_max else 0.0)
+    mean_live = sum(live_rank) / world if world else 0.0
+    imbalance = (max(live_rank) / mean_live) if mean_live else float("inf")
+
+    n_sliced = sum(len(t) for t in strategy.table_ids_list)
+    report = PlanReport(
+        label=label or f"{strategy.strategy}/world{world}",
+        chip=chip, world=world, strategy=strategy.strategy,
+        dp_input=bool(dp_input), global_batch=int(global_batch),
+        local_batch=b_local,
+        param_dtype=_dtype_name(param_dtype),
+        comm_dtype=_dtype_name(comm_dtype),
+        optimizer=model.name,
+        n_tables=len(strategy.global_configs),
+        n_sliced_tables=n_sliced,
+        n_groups=len(plan.groups), l_max=plan.l_max, s_max=plan.s_max,
+        groups=[{"kind": g.kind, "width": g.width, "hot": g.hot,
+                 "slots": g.n, "block_len": g.blen} for g in plan.groups],
+        per_rank=per_rank, slabs=slabs,
+        id_a2a_bytes_per_step=int(id_a2a),
+        out_a2a_bytes_per_step=int(out_a2a),
+        grad_a2a_bytes_per_step=int(out_a2a),
+        total_a2a_bytes_per_step=int(id_a2a + 2 * out_a2a),
+        imbalance_ratio=float(imbalance),
+        out_pad_frac=float(pad_frac))
+    if contract is not None:
+        check_contract(report, contract, strategy=strategy)
+    return report
+
+
+def audit_plan_spec(spec: Dict[str, Any],
+                    *,
+                    optimizer="sgd",
+                    param_dtype="float32",
+                    chip: str = "v5e",
+                    contract: Optional[PlanContract] = None,
+                    label: Optional[str] = None) -> PlanReport:
+    """Capacity-only audit of a bare :meth:`DistEmbeddingStrategy.
+    plan_spec` dict (e.g. read back from a checkpoint's ``meta.json``).
+    The spec carries slice geometry but no input routing, so exchange
+    payloads/groups price at zero — HBM and cliff contracts still
+    apply (pair with :func:`audit_plan` for the full model)."""
+
+    class _SpecView:
+        """Duck-typed strategy view over the spec's ``local_tables``."""
+
+        def __init__(self, s):
+            self.world_size = int(s["world_size"])
+            self.strategy = s.get("strategy", "?")
+            self.local_configs_list = [
+                [{"input_dim": rows, "output_dim": width}
+                 for (_tid, rows, width, _rb, _cs) in rank]
+                for rank in s["local_tables"]]
+            self.table_ids_list = [[t[0] for t in rank]
+                                   for rank in s["local_tables"]]
+            self.global_configs = [None] * (max(
+                (t[0] for rank in s["local_tables"] for t in rank),
+                default=-1) + 1)
+            self.input_table_map = []
+
+    view = _SpecView(spec)
+    world = view.world_size
+    geom = slab_geometry(view)
+    p_isz = _dtype_bytes(param_dtype)
+    model = optimizer_model(optimizer)
+    alloc_rank = geom.rank_param_bytes(p_isz)
+    opt_rank = (model.slots * alloc_rank
+                + model.aux_bytes_per_slab * len(geom.widths))
+    chip_spec = CHIP_SPECS[chip]
+    live_rank = [sum(int(c["input_dim"]) * int(c["output_dim"]) * p_isz
+                     for c in cfgs) for cfgs in view.local_configs_list]
+    per_rank = [RankBudget(
+        rank=r, tables=len(view.local_configs_list[r]),
+        live_param_bytes=live_rank[r], alloc_param_bytes=alloc_rank,
+        opt_state_bytes=opt_rank, a2a_buffer_bytes=0,
+        total_bytes=alloc_rank + opt_rank,
+        hbm_frac=(alloc_rank + opt_rank) / chip_spec.hbm_bytes)
+        for r in range(world)]
+    slabs = []
+    for w in geom.widths:
+        rb = geom.phys_cap[w] * geom.phys_w[w] * p_isz
+        cliff = ("past_cliff" if rb >= SCATTER_CLIFF_BYTES
+                 else "cliff_band" if rb > SCATTER_CLIFF_SAFE_BYTES
+                 else "sub_cliff")
+        slabs.append(SlabBudget(w, geom.phys_cap[w], geom.phys_w[w], rb,
+                                cliff))
+    mean_live = sum(live_rank) / world if world else 0.0
+    report = PlanReport(
+        label=label or f"spec/{view.strategy}/world{world}",
+        chip=chip, world=world, strategy=view.strategy, dp_input=True,
+        global_batch=0, local_batch=0,
+        param_dtype=_dtype_name(param_dtype),
+        comm_dtype=_dtype_name(param_dtype),
+        optimizer=model.name, n_tables=len(view.global_configs),
+        n_sliced_tables=sum(len(t) for t in view.table_ids_list),
+        n_groups=0, l_max=0, s_max=0, groups=[], per_rank=per_rank,
+        slabs=slabs, id_a2a_bytes_per_step=0, out_a2a_bytes_per_step=0,
+        grad_a2a_bytes_per_step=0, total_a2a_bytes_per_step=0,
+        imbalance_ratio=(max(live_rank) / mean_live) if mean_live
+        else float("inf"),
+        out_pad_frac=0.0)
+    if contract is not None:
+        # exchange/group limits are unknowable from a bare spec
+        capacity_only = dataclasses.replace(
+            contract, max_a2a_bytes_per_step=None, max_groups=None)
+        check_contract(report, capacity_only, strategy=view)
+    return report
+
+
+# --------------------------------------------------------------------------
+# calibration + planner ranking
+# --------------------------------------------------------------------------
+
+
+def compare_with_memory(report: PlanReport,
+                        mem_report: Dict[str, Any]) -> Dict[str, Any]:
+    """Drift of the jax-free byte model against
+    :func:`.memory.table_memory_report`'s ``eval_shape`` accounting (the
+    calibration target). Returns fractional drifts per component plus
+    ``max_abs_drift``; the CLI's strict mode requires ~exact agreement
+    (the two compute the same layout — drift means the mirror broke)."""
+    totals = mem_report["totals"]
+    world = mem_report["world"]
+
+    def drift(pred, target):
+        if not target:
+            return 0.0 if not pred else float("inf")
+        return (pred - target) / target
+
+    pred_alloc = sum(r.alloc_param_bytes for r in report.per_rank)
+    pred_live = sum(r.live_param_bytes for r in report.per_rank)
+    pred_opt = sum(r.opt_state_bytes for r in report.per_rank)
+    out = {
+        "param_alloc_drift": drift(pred_alloc,
+                                   totals["param_bytes_allocated"]),
+        "param_live_drift": drift(pred_live, totals["param_bytes_live"]),
+        "opt_state_drift": (
+            drift(pred_opt, totals["opt_state_bytes"])
+            if totals.get("opt_state_bytes") is not None else 0.0),
+        "world": world,
+    }
+    out["max_abs_drift"] = max(abs(v) for k, v in out.items()
+                               if k.endswith("_drift"))
+    return out
+
+
+def rank_strategies(configs,
+                    world: int,
+                    global_batch: int,
+                    strategies: Sequence[str] = ("basic", "memory_balanced",
+                                                 "memory_optimized",
+                                                 "comm_balanced"),
+                    column_slice_threshold: Optional[int] = None,
+                    row_slice_threshold: Optional[int] = None,
+                    input_table_map=None,
+                    input_hotness=None,
+                    **audit_kw) -> List[Tuple[str, PlanReport]]:
+    """Plan every candidate strategy and rank them by predicted cost —
+    the planner-side cost hook (``telemetry_balanced`` is excluded by
+    default: it needs measured ``table_loads``).
+
+    Sort key: contract-violating plans last, then max per-rank bytes,
+    then total a2a payload — "fits first, cheapest exchange among those
+    that fit". Returns ``[(strategy_name, PlanReport)]`` best first.
+    """
+    from ..parallel.strategy import DistEmbeddingStrategy
+
+    contract = audit_kw.pop("contract", None)
+    out = []
+    for name in strategies:
+        st = DistEmbeddingStrategy(
+            configs, world, strategy=name,
+            input_table_map=input_table_map,
+            column_slice_threshold=column_slice_threshold,
+            row_slice_threshold=row_slice_threshold,
+            input_hotness=input_hotness)
+        rep = audit_plan(st, global_batch, label=f"{name}/world{world}",
+                         contract=contract, **audit_kw)
+        out.append((name, rep))
+    out.sort(key=lambda kv: (len(kv[1].violations),
+                             kv[1].max_rank_bytes,
+                             kv[1].total_a2a_bytes_per_step))
+    return out
+
+
+def report_to_jsonl(report: PlanReport) -> str:
+    """One-line JSON form (sidecar-friendly)."""
+    return json.dumps(report.to_json(), sort_keys=True)

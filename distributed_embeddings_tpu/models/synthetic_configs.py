@@ -108,7 +108,7 @@ model_colossal = ModelConfig(
     embedding_configs=[
         EmbeddingConfig(100, [1, 300], 100000, 128, True),
         EmbeddingConfig(50, [1, 300], 40000000, 256, True),
-        EmbeddingConfig(1, [1, 300], 2000000000, 256, True),
+        EmbeddingConfig(1, [1, 300], 2000000000, 256, True),  # capacity-ok: reference zoo vocab size, not a hardware limit
         EmbeddingConfig(1, [1], 1000000000, 256, False),
         EmbeddingConfig(100, [1], 10, 32, False),
         EmbeddingConfig(400, [1], 10000, 128, False),

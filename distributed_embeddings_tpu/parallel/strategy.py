@@ -513,6 +513,24 @@ class DistEmbeddingStrategy:
     def num_inputs(self) -> int:
         return len(self.input_table_map)
 
+    def predicted_cost(self, global_batch: int, **audit_kw):
+        """Price this plan without building anything — the planner-side
+        cost hook. Delegates to :func:`...analysis.plan_audit.audit_plan`
+        (a backend-free byte/comms model calibrated against the executor:
+        slab geometry, exchange padding, per-step all-to-all payloads)
+        and returns its :class:`~...analysis.plan_audit.PlanReport`.
+
+        Keyword args pass through (``optimizer=``, ``param_dtype=``,
+        ``encodings=``, ``contract=``, ...). Use
+        :func:`...analysis.plan_audit.rank_strategies` to compare
+        candidate strategies by this cost before committing to one —
+        "does it fit, and what does the exchange cost" answered at plan
+        time, the way GSPMD-style systems validate placements before
+        touching a pod."""
+        from ..analysis import plan_audit
+
+        return plan_audit.audit_plan(self, global_batch, **audit_kw)
+
     def describe(self, param_bytes: int = 4) -> str:
         """Human-readable placement summary. ``param_bytes``: bytes per
         table element (pass 2 for bf16 tables — the benched headline

@@ -114,6 +114,23 @@ flags.DEFINE_string("metrics_out", None,
 flags.DEFINE_integer("metrics_interval", 100,
                      "log a step-metrics record every N training steps "
                      "(only when metrics are enabled)")
+flags.DEFINE_enum("plan_audit", "off", ["off", "warn", "strict"],
+                  "plan-time capacity preflight (analysis.plan_audit): "
+                  "price the placement plan — per-rank HBM, per-step "
+                  "all-to-all payloads, apply-slab scatter-cliff exposure "
+                  "— BEFORE any table is materialized, against the "
+                  "--plan_audit_chip contract. 'warn' prints the report "
+                  "and any violations; 'strict' additionally refuses to "
+                  "start a plan that violates its contract (exit 2) — the "
+                  "capacity gate you run before touching a pod")
+flags.DEFINE_string("plan_audit_chip", "v5e",
+                    "capacity-registry chip the preflight contract binds "
+                    "to (see analysis.plan_audit.CHIP_SPECS)")
+flags.DEFINE_enum("param_dtype", "float32", ["float32", "bfloat16"],
+                  "embedding table (slab) dtype. bfloat16 halves per-rank "
+                  "HBM and a2a activation payloads — the dtype the "
+                  "Criteo-1TB v5e-16 deployment plan is audited at; the "
+                  "plan-audit preflight prices whichever is selected")
 
 
 def synthetic_batches(cfg, num_batches, batch_size, seed=0):
@@ -178,6 +195,27 @@ def main(_):
     if is_chief:
         print(de.strategy.describe())
 
+    if FLAGS.plan_audit != "off":
+        # the capacity gate, BEFORE anything is materialized: the same
+        # backend-free model `tools/plan_audit.py --strict` enforces in
+        # make verify, here bound to this run's actual plan/batch/input
+        # mode. A plan that cannot fit (or holds a past-cliff apply
+        # slab) fails in milliseconds instead of OOMing a pod.
+        from distributed_embeddings_tpu.analysis import plan_audit as pa
+        report = pa.audit_plan(
+            de, FLAGS.batch_size, optimizer="sgd",
+            param_dtype=FLAGS.param_dtype,
+            dp_input=not use_mp_input, chip=FLAGS.plan_audit_chip,
+            label="dlrm_preflight",
+            contract=pa.default_contract(FLAGS.plan_audit_chip))
+        if is_chief:
+            print(report.markdown())
+        if not report.ok and FLAGS.plan_audit == "strict":
+            print(f"plan_audit: {len(report.violations)} capacity "
+                  "contract violation(s); refusing to start (use "
+                  "--plan_audit=warn to proceed anyway)", file=sys.stderr)
+            sys.exit(2)
+
     dense_params = dense.init(
         jax.random.key(0),
         jnp.zeros((2, cfg.num_numerical_features), jnp.float32),
@@ -210,7 +248,8 @@ def main(_):
                   "from", FLAGS.restore_state)
     else:
         state = init_hybrid_state(de, emb_opt, dense_params, tx,
-                                  jax.random.key(1), mesh=mesh)
+                                  jax.random.key(1), mesh=mesh,
+                                  dtype=jnp.dtype(FLAGS.param_dtype))
     # DETPU_TELEMETRY=1: build the step with jit-carried access
     # telemetry (hot-row sketches + per-rank loads); the resilient
     # driver threads the state and flushes <save_state>.telemetry.json

@@ -15,7 +15,8 @@ import pytest
 from distributed_embeddings_tpu.utils import envvars
 from tools import detlint
 from tools.detlint.rules import (bare_except, eager_backend, env_registry,
-                                 host_fetch, module_scope_jax, named_scope,
+                                 hardcoded_capacity, host_fetch,
+                                 module_scope_jax, named_scope,
                                  unsized_unique)
 
 CTX = {"repo": detlint.REPO}
@@ -117,6 +118,37 @@ def test_unsized_unique_rule():
     assert not detlint._matches("tools/x.py", unsized_unique.SCOPE)
 
 
+def test_hardcoded_capacity_rule():
+    """The seeded drills: a capacity-named constant and a byte-scale
+    literal in package code fire; the marker, small literals, hex hash
+    constants, and the registry module itself stay quiet."""
+    path = "distributed_embeddings_tpu/parallel/x.py"
+    # seeded capacity constant (any magnitude) fires
+    assert _check(hardcoded_capacity, "V5E_HBM_GB = 16\n", path=path)
+    # seeded byte-scale literal fires
+    assert _check(hardcoded_capacity,
+                  "LIMIT = 17179869184\n", path=path)
+    assert _check(hardcoded_capacity,
+                  "def f():\n    return 2.7e9\n", path=path)
+    # the marker escapes both triggers
+    assert not _check(
+        hardcoded_capacity,
+        "V5E_HBM_GB = 16  # capacity-ok: doc example\n", path=path)
+    assert not _check(
+        hardcoded_capacity,
+        "VOCAB = 2000000000  # capacity-ok: model size\n", path=path)
+    # small non-capacity constants and hex bit patterns stay quiet
+    assert not _check(hardcoded_capacity, "CHUNK = 128 * 1024 * 1024\n",
+                      path=path)
+    assert not _check(hardcoded_capacity, "MASK = 0xFFFFFFFFFF\n",
+                      path=path)
+    # the registry module is the one legitimate home (EXCLUDE'd)
+    assert detlint._matches(
+        "distributed_embeddings_tpu/analysis/plan_audit.py",
+        hardcoded_capacity.EXCLUDE)
+    assert not detlint._matches("bench.py", hardcoded_capacity.SCOPE)
+
+
 def test_module_scope_jax_rule():
     path = "distributed_embeddings_tpu/utils/obs.py"
     assert _check(module_scope_jax, "import jax\n", path=path)
@@ -131,9 +163,9 @@ def test_module_scope_jax_rule():
 
 def test_discover_rules_finds_all():
     rules = detlint.discover_rules()
-    assert {"bare-except", "eager-backend", "env-registry", "host-fetch",
-            "module-scope-jax", "named-scope-exchange",
-            "unsized-unique"} <= set(rules)
+    assert {"bare-except", "eager-backend", "env-registry",
+            "hardcoded-capacity", "host-fetch", "module-scope-jax",
+            "named-scope-exchange", "unsized-unique"} <= set(rules)
 
 
 def test_unknown_rule_name_raises():

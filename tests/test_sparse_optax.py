@@ -461,3 +461,105 @@ def test_dedup_false_env_hatch_forces_dedup_back(monkeypatch):
     f2 = sparse_value_and_grad(loss_fn, combiners=[None], dedup=False)
     _, (_, sg2) = f2({}, [table], [ids])
     assert not sg2[0].unique
+
+
+# ------------------------------------------------ ROADMAP 1 diagnostic:
+# SparseSGD vs an equivalent dense one-hot-matmul SGD model
+
+
+@pytest.mark.parametrize("combiner,hot", [(None, 1), ("mean", 3),
+                                          ("sum", 3)])
+def test_hybrid_sparse_sgd_matches_dense_onehot_sgd(combiner, hot):
+    """The lr-coupling half of the ROADMAP 1 question, isolated.
+
+    The planted-signal task's embedding half does not learn under
+    SparseSGD (VERDICT "Missing #2"); the cross-world test (PR 6) ruled
+    out a 1/world mp grad-scale defect. The two remaining suspects were
+    (a) an lr coupling hiding in the sparse pipeline — SparseSGD's
+    effective step differing from plain SGD at the same lr — and
+    (b) init scale / task conditioning. This test settles (a): the FULL
+    hybrid path (packed slab layout, lane-packed gather/scatter, plan
+    executor, sparse backward) must produce, step for step, the same
+    trajectory as a dense model in which the lookup is written as
+    ``one_hot(ids) @ table`` and BOTH halves are trained by plain
+    ``optax.sgd`` at the same lr — duplicates in the batch included
+    (they scatter-add on one side and accumulate through the matmul
+    transpose on the other).
+
+    Verdict (recorded in ROADMAP item 1): this test passes — the sparse
+    path IS plain SGD, at exactly the declared lr, for sum/mean/no
+    combiner. The remaining suspect for the planted-task failure is
+    init scale / task conditioning, not the optimizer.
+    """
+    rng = np.random.default_rng(7)
+    vocab, w, b, lr, steps = 12, 4, 16, 0.5, 8
+    shape = (b,) if combiner is None else (b, hot)
+    id_steps = [jnp.asarray(rng.integers(0, vocab, size=shape), jnp.int32)
+                for _ in range(steps)]
+    tgt_steps = [jnp.asarray(rng.normal(size=(b, 1)), jnp.float32)
+                 for _ in range(steps)]
+
+    # --- hybrid path: SparseSGD through make_hybrid_train_step, world 1
+    de = DistributedEmbedding(
+        [{"input_dim": vocab, "output_dim": w, "combiner": combiner}],
+        world_size=1)
+    emb_opt = SparseSGD()
+    tx = optax.sgd(lr)
+    # host-side init shared by both models: the hybrid step DONATES its
+    # state, so each side must get its own device buffer
+    proj0 = rng.normal(size=(w, 1)).astype(np.float32)
+
+    def loss_fn(dp, outs, batch):
+        o = outs[0]
+        if combiner is None and o.ndim == 3:  # [b, 1, w] rank-preserved
+            o = o.reshape(o.shape[0], -1)
+        return jnp.mean((o @ dp["proj"] - batch) ** 2)
+
+    state = init_hybrid_state(de, emb_opt, {"proj": jnp.asarray(proj0)},
+                              tx, jax.random.key(3))
+    step = make_hybrid_train_step(de, loss_fn, tx, emb_opt,
+                                  lr_schedule=lr, nan_guard=False,
+                                  with_metrics=False)
+
+    # --- dense twin: identical init, lookup as one_hot @ table, plain
+    # optax.sgd over BOTH the table and the projection
+    table0 = np.asarray(de.get_weights(state.emb_params)[0])
+    dense_params = {"table": jnp.asarray(table0),
+                    "proj": jnp.asarray(proj0)}
+    dtx = optax.sgd(lr)
+    dopt = dtx.init(dense_params)
+
+    def dense_loss(p, ids, y):
+        oh = jax.nn.one_hot(ids, vocab, dtype=jnp.float32)
+        gathered = oh @ p["table"]            # [b(, hot), w]
+        if combiner == "mean":
+            gathered = gathered.mean(axis=1)
+        elif combiner == "sum":
+            gathered = gathered.sum(axis=1)
+        return jnp.mean((gathered @ p["proj"] - y) ** 2)
+
+    @jax.jit
+    def dense_step(p, o, ids, y):
+        loss, g = jax.value_and_grad(dense_loss)(p, ids, y)
+        upd, o = dtx.update(g, o, p)
+        return loss, optax.apply_updates(p, upd), o
+
+    for k in range(steps):
+        loss_h, state = step(state, [id_steps[k]], tgt_steps[k])
+        loss_d, dense_params, dopt = dense_step(dense_params, dopt,
+                                                id_steps[k], tgt_steps[k])
+        np.testing.assert_allclose(float(loss_h), float(loss_d),
+                                   rtol=1e-5,
+                                   err_msg=f"loss diverged at step {k}")
+        [table_h] = de.get_weights(state.emb_params)
+        np.testing.assert_allclose(
+            np.asarray(table_h), np.asarray(dense_params["table"]),
+            rtol=1e-4, atol=1e-6,
+            err_msg=f"table trajectory diverged at step {k} — an lr "
+                    "coupling in the sparse path")
+        np.testing.assert_allclose(
+            np.asarray(state.dense_params["proj"]),
+            np.asarray(dense_params["proj"]), rtol=1e-4, atol=1e-6)
+    # the run must have actually trained the table (a frozen embedding
+    # half matching a frozen twin would vacuously pass)
+    assert float(np.abs(table0 - np.asarray(table_h)).max()) > 1e-3

@@ -174,3 +174,114 @@ def test_comm_balanced_end_to_end_parity():
     routed = sorted(i for r in s.input_ids_list for i in r)
     assert routed == list(range(10))
     assert sorted(s.rev_global_input_ids) == list(range(10))
+
+
+# --------------------------------------- extreme shapes (ISSUE 8): the
+# planner is pure host metadata — 188M-row tables must plan in
+# milliseconds without materializing any array
+
+
+# the real Criteo-1TB vocab vector, single-sourced so these tests can
+# never drift from what the capacity auditor and bench price
+from tools._profcommon import CRITEO_1TB_SIZES as C1TB_188M  # noqa: E402
+
+
+def _check_plan_valid(st, n_tables):
+    """Structural invariants every plan must hold: every table placed on
+    at least one rank, per-rank maps aligned, spec JSON-able."""
+    placed = sorted({t for rank in st.table_ids_list for t in rank})
+    assert placed == list(range(n_tables))
+    # every rank's routing views are mutually aligned
+    for r in range(st.world_size):
+        assert len(st.local_configs_list[r]) == len(st.table_ids_list[r])
+        assert len(st.input_ids_list[r]) == len(st.local_map_list[r])
+        for m in st.local_map_list[r]:
+            assert 0 <= m < len(st.local_configs_list[r])
+    # the fingerprint is valid JSON with consistent per-rank elements
+    import json
+    spec = json.loads(json.dumps(st.plan_spec()))
+    assert spec["world_size"] == st.world_size
+    assert len(spec["local_tables"]) == st.world_size
+    for r, entries in enumerate(spec["local_tables"]):
+        total = sum(rows * width for _t, rows, width, _rb, _cs in entries)
+        assert total == spec["per_rank_elements"][r]
+    # global element conservation: slices partition every table
+    total_elems = sum(spec["per_rank_elements"])
+    return total_elems
+
+
+@pytest.mark.parametrize("strategy", ["basic", "memory_balanced",
+                                      "memory_optimized", "comm_balanced",
+                                      "telemetry_balanced"])
+def test_planners_at_188m_row_shapes(strategy):
+    """Every planner produces a valid plan at the real Criteo-1TB row
+    counts (~188M rows, 26 tables, world 16) — instantly and without
+    arrays."""
+    configs = [cfg(s, 128) for s in C1TB_188M]
+    kw = {}
+    if strategy == "telemetry_balanced":
+        kw["table_loads"] = [float(s) for s in C1TB_188M]
+    st = DistEmbeddingStrategy(configs, 16, strategy=strategy, **kw)
+    total = _check_plan_valid(st, len(configs))
+    assert total == sum(s * 128 for s in C1TB_188M)
+
+
+def test_telemetry_balanced_without_loads_raises_cleanly():
+    with pytest.raises(ValueError, match="table_loads"):
+        DistEmbeddingStrategy([cfg(s, 128) for s in C1TB_188M], 16,
+                              strategy="telemetry_balanced")
+
+
+def test_world_equals_tables_boundary():
+    """world == #tables: every rank owns exactly one table, for every
+    planner, at 188M-row scale."""
+    configs = [cfg(s, 128) for s in C1TB_188M]
+    world = len(configs)
+    for strategy in ("basic", "memory_balanced", "memory_optimized",
+                     "comm_balanced"):
+        st = DistEmbeddingStrategy(configs, world, strategy=strategy)
+        assert all(len(r) == 1 for r in st.table_ids_list), strategy
+        _check_plan_valid(st, len(configs))
+
+
+def test_column_slice_threshold_at_188m_shapes():
+    """Column slicing at real shapes: the >1.4e9-element tables split
+    4-way (power of 2), slices partition the width exactly, and the
+    sliced-out ranges reassemble in input order."""
+    configs = [cfg(s, 128) for s in C1TB_188M]
+    st = DistEmbeddingStrategy(configs, 16, strategy="comm_balanced",
+                               column_slice_threshold=1_400_000_000)
+    big = [t for t, s in enumerate(C1TB_188M) if s * 128 > 1_400_000_000]
+    sliced, _ranges, _rranges, _rows = st.create_sliced_configs(
+        16, 1_400_000_000, st.input_table_map)
+    for t in big:
+        assert len(sliced[t]) == 4, (t, len(sliced[t]))
+        assert sum(c["output_dim"] for c in sliced[t]) == 128
+        assert all(c["input_dim"] == C1TB_188M[t] for c in sliced[t])
+    for t in range(len(configs)):
+        if t not in big:
+            assert len(sliced[t]) == 1
+    _check_plan_valid(st, len(configs))
+    # ranges cover exactly the sliced inputs, in ascending input order
+    starts = [s for s, _ in st.sliced_out_ranges]
+    assert starts == sorted(starts)
+    assert len(st.sliced_out_ranges) == len(big)
+
+
+def test_column_slice_precedence_over_row_slice_at_scale():
+    """A table split by the column threshold is NOT row-sliced even when
+    it also exceeds the row threshold (the two thresholds express one
+    capacity constraint; doubly-sliced tables have no exchange
+    layout)."""
+    configs = [cfg(s, 128) for s in C1TB_188M]
+    st = DistEmbeddingStrategy(configs, 16,
+                               column_slice_threshold=1_400_000_000,
+                               row_slice_threshold=1_000_000_000)
+    big_col = {t for t, s in enumerate(C1TB_188M)
+               if s * 128 > 1_400_000_000}
+    # row-sliced tables are exactly those over the ROW threshold but
+    # under the column one
+    for t in st.row_sliced_tables:
+        assert t not in big_col
+        assert C1TB_188M[t] * 128 > 1_000_000_000
+    _check_plan_valid(st, len(configs))

@@ -39,6 +39,26 @@ CRITEO_KAGGLE_SIZES = [
 ]
 CAP_SIZES = [min(s, CAP) for s in CRITEO_KAGGLE_SIZES]
 
+# Criteo-1TB (MLPerf DLRM) vocab sizes + the reference's "+1" convention
+# (its examples/dlrm/main.py loads model_size.json and adds 1): 26 tables,
+# ~187.8M rows total — the real shapes behind the ≥2M samples/s v5e-16
+# north star. Shared here so bench.py, the capacity auditor, and the
+# dress-rehearsal tooling price the SAME vector (they used to drift).
+CRITEO_1TB_SIZES = [s + 1 for s in [
+    39884406, 39043, 17289, 7420, 20263, 3, 7120, 1543, 63, 38532951,
+    2953546, 403346, 10, 2208, 11938, 155, 4, 976, 14, 39979771, 25641295,
+    39664984, 585935, 12972, 108, 36,
+]]
+# Column-slice threshold (elements) of the criteo1tb reference case: the
+# five ~25-40M-row tables (3.3-5.1e9 elements at dim 128) split 4-way into
+# width-32 slices, putting every per-rank apply slab under the measured
+# scatter cliff at world=16 bf16 (analysis/plan_audit.py enforces this);
+# the <=1.4e9-element tables stay whole.
+CRITEO1TB_COL_SLICE = 1_400_000_000
+CRITEO1TB_DIM = 128
+CRITEO1TB_BATCH = 65536
+CRITEO1TB_WORLD = 16
+
 
 def build_case(name: str, world: int, batch: int):
     """One reference DistributedEmbedding configuration: ``(de, cat_inputs,
@@ -48,10 +68,17 @@ def build_case(name: str, world: int, batch: int):
     ``tools/hlo_audit.py`` (optimized-HLO pass budgets) so both gates and
     the profile tools cannot drift apart.
 
-    Cases: ``dense`` / ``ragged`` / ``row_sliced`` (the tier-1 shapes) and
+    Cases: ``dense`` / ``ragged`` / ``row_sliced`` (the tier-1 shapes),
     ``bigvocab`` — vocab rows >> the id stream, so stateful sparse
     optimizers compile their sort-dedup path instead of the dense-apply
-    regime (the configuration the dedup pass budget is pinned on).
+    regime (the configuration the dedup pass budget is pinned on) — and
+    ``criteo1tb`` — the REAL 26-table Criteo-1TB vocab vector at dim 128
+    with the reference column-slice threshold (``CRITEO1TB_COL_SLICE``),
+    the shapes the plan-time capacity auditor (``tools/plan_audit.py``)
+    enforces its HBM/cliff contracts at. Building it materializes
+    nothing (plans are host metadata; inputs are ShapeDtypeStructs), but
+    only the static tools should ask for it — ``de.init`` at these
+    shapes is 48 GB of bf16.
     """
     import jax
     import jax.numpy as jnp
@@ -88,6 +115,18 @@ def build_case(name: str, world: int, batch: int):
                     "combiner": ["sum", None, "mean"][i % 3]}
                    for i in range(10)]
         de = DistributedEmbedding(configs, world_size=world)
+        cats = dense_cats(configs)
+    elif name == "criteo1tb":
+        # mp input + comm_balanced: the ROADMAP item-4 deployment shape
+        # (the dlrm example's defaults at scale). dp_input stays True in
+        # the returned layer so the case also traces on the generic
+        # harnesses; the capacity audit prices the mp-input variant via
+        # audit_plan(dp_input=False).
+        configs = [{"input_dim": int(s), "output_dim": CRITEO1TB_DIM,
+                    "combiner": None} for s in CRITEO_1TB_SIZES]
+        de = DistributedEmbedding(configs, world_size=world,
+                                  strategy="comm_balanced",
+                                  column_slice_threshold=CRITEO1TB_COL_SLICE)
         cats = dense_cats(configs)
     elif name == "ragged":
         configs = [{"input_dim": 40 + 7 * i, "output_dim": 8,

@@ -13,9 +13,12 @@ Accepts either the driver's wrapper format (``{"rc": ..., "parsed":
 * 0 — every comparable metric within the threshold;
 * 1 — at least one regression beyond the threshold (throughput metrics
   dropping, or ms-per-iter metrics rising, by more than ``--threshold``,
-  default 10%), a nonzero steady-state recompile count, or a per-phase
+  default 10%), a nonzero steady-state recompile count, a per-phase
   HLO pass-count regression / contract violation in the candidate's
-  ``phase_budget`` census (:func:`check_phase_budget`);
+  ``phase_budget`` census (:func:`check_phase_budget`), or a
+  ``plan_audit`` capacity failure — contract violation or a
+  predicted-vs-measured byte drift beyond ±15%
+  (:func:`check_plan_audit`);
 * 2 — unusable inputs (missing file, no parseable payload).
 
 Metrics present in only one record are reported but never fail the gate
@@ -202,10 +205,78 @@ def check_phase_budget(old: Dict[str, Any], new: Dict[str, Any]) -> int:
     return failures
 
 
+#: max tolerated |predicted - measured| / measured byte drift of the
+#: bench's plan_audit section (the plan-time capacity model must stay
+#: validated against XLA's own accounting, not decorative)
+PLAN_AUDIT_DRIFT_TOL = 0.15
+
+
+def check_plan_audit(old: Dict[str, Any], new: Dict[str, Any]) -> int:
+    """The PR 8 capacity gate: the bench record embeds the plan-time
+    byte model's self-check (``plan_audit``: predicted argument bytes of
+    the compiled headline step vs XLA ``memory_analysis``, plus the
+    contract audit of the headline and Criteo-1TB plans). Three absolute
+    checks on the candidate:
+
+    * any contract violation (headline or the criteo1tb deployment
+      plan) fails outright — an over-HBM or past-cliff plan must never
+      ride a green bench record;
+    * ``byte_drift_frac`` beyond ±15% fails — the predictor drifted
+      from what XLA actually allocates and can no longer be trusted as
+      a pre-pod gate;
+    * a candidate missing the section while the baseline has it fails
+      (the audit crashed or was skipped — silence would hide exactly
+      the regressions the gate exists to catch).
+    """
+    nb = new.get("plan_audit")
+    if not isinstance(nb, dict):
+        if isinstance(old.get("plan_audit"), dict):
+            print("compare_bench: candidate record has no plan_audit "
+                  "section but the baseline does — the capacity audit "
+                  "failed or was skipped; the plan gate cannot run",
+                  file=sys.stderr)
+            return 1
+        return 0
+    failures = 0
+    for v in nb.get("violations") or []:
+        print(f"compare_bench: plan_audit contract violation in the "
+              f"candidate record: {v}", file=sys.stderr)
+        failures += 1
+    c1tb = nb.get("criteo1tb")
+    if isinstance(c1tb, dict):
+        for v in c1tb.get("violations") or []:
+            print(f"compare_bench: plan_audit criteo1tb violation in the "
+                  f"candidate record: {v}", file=sys.stderr)
+            failures += 1
+    drift = nb.get("byte_drift_frac")
+    if drift is None:
+        # the predictor was never validated this round (compile or
+        # memory_analysis failed) — that is a gate failure whenever the
+        # baseline shows validation used to work, not a silent pass
+        ob = old.get("plan_audit")
+        if isinstance(ob, dict) and ob.get("byte_drift_frac") is not None:
+            print("compare_bench: plan_audit byte_drift_frac is null in "
+                  "the candidate (compile_error="
+                  f"{nb.get('compile_error')!r}) but the baseline had a "
+                  "measured drift — the capacity predictor went "
+                  "unvalidated", file=sys.stderr)
+            failures += 1
+    elif isinstance(drift, (int, float)) and abs(drift) > PLAN_AUDIT_DRIFT_TOL:
+        print(f"compare_bench: plan_audit byte drift {drift:+.1%} exceeds "
+              f"±{PLAN_AUDIT_DRIFT_TOL:.0%}: predicted "
+              f"{nb.get('predicted_argument_mb')} MB vs measured "
+              f"{nb.get('measured_argument_mb')} MB — the plan-time "
+              "capacity model no longer matches XLA's accounting",
+              file=sys.stderr)
+        failures += 1
+    return failures
+
+
 def compare(old: Dict[str, Any], new: Dict[str, Any],
             threshold: float) -> int:
     steady_failures = check_steady_state(new)
     steady_failures += check_phase_budget(old, new)
+    steady_failures += check_plan_audit(old, new)
     regressions = 0
     rows = []
     for keys, higher_better in ((THROUGHPUT_KEYS, True), (MS_KEYS, False)):
