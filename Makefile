@@ -5,7 +5,8 @@ SHELL := /bin/bash
 
 .PHONY: all native test test-fast bench bench-diff clean pkg verify \
         lint plan-audit audit-step hlo-audit check-backend check-obs \
-        check-obs-report check-resilience check-reshard obs-report
+        check-obs-report check-resilience check-reshard check-recovery \
+        obs-report
 
 all: native
 
@@ -28,7 +29,7 @@ bench:
 # no-eager-backend shim), the observability gate, and the
 # preemption-recovery drill — run before shipping a round
 verify: lint plan-audit audit-step hlo-audit check-backend check-obs \
-        check-obs-report check-resilience check-reshard
+        check-obs-report check-resilience check-reshard check-recovery
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -96,6 +97,13 @@ check-resilience:
 # require determinism + logical-state equality vs the uninterrupted run
 check-reshard:
 	python tools/check_reshard.py
+
+# NaN-storm chaos drill: a child run with DETPU_FAULT=nan@<step> must roll
+# back to a ring checkpoint, quarantine the poisoned batch (naming the
+# unhealthy table via the per-table sentinels), finish clean, and match
+# the stream-minus-poison run's final checkpoint bit for bit
+check-recovery:
+	python tools/check_recovery.py
 
 # optional regression gate: diff two BENCH records, nonzero exit on a >10%
 # throughput regression. Usage: make bench-diff OLD=BENCH_r04.json NEW=out.json

@@ -599,6 +599,11 @@ def run_resilient_overhead():
         "nanguard_samples_per_sec": round(sps_guard, 1),
         "resilient_samples_per_sec": round(sps_resilient, 1),
         "raw_loop_samples_per_sec": round(sps_loop, 1),
+        # the instrumented+guarded step now computes the per-table health
+        # sentinels in-program (table_grad_norm / table_update_maxabs /
+        # table_nonfinite): this throughput IS the sentinel-bearing step,
+        # gated by compare_bench like any headline metric
+        "sentinel_samples_per_sec": round(batch / dt_m_guard, 1),
         # on-device guard cost vs the unguarded step (metrics off: the
         # guard pays for the grad-energy reductions itself)
         "guard_overhead_frac": round(1.0 - sps_guard / sps_raw, 4),
@@ -609,6 +614,71 @@ def run_resilient_overhead():
         # host-driver cost vs the same guarded per-dispatch step
         "driver_overhead_frac": round(1.0 - sps_resilient / sps_guard, 4),
         "steps": iters,
+    }
+
+
+def run_recovery():
+    """Rollback-and-replay recovery cost (the chaos-path price tag, not a
+    throughput headline): a small hybrid run with a checkpoint ring hits
+    an engineered NaN batch, the driver rolls back to a ring entry,
+    replays, quarantines the poison, and completes — reporting the
+    restore wall-time (``rollback_wall_time_s``, the recovery's only
+    off-the-training-path cost) and the drill's bookkeeping. The
+    sentinel overhead itself rides ``sentinel_samples_per_sec`` in the
+    ``resilient_overhead`` section (the instrumented+guarded step IS the
+    sentinel-bearing program)."""
+    import tempfile
+
+    from distributed_embeddings_tpu.parallel import run_resilient
+
+    table_sizes = [1000] * 8
+    batch = 4096
+    cfg = make_cfg(table_sizes, jnp.float32)
+    emb_opt = SparseSGD()
+    tx = optax.sgd(0.005)
+    de = DistributedEmbedding(cfg.embedding_configs(), world_size=1)
+    dense = DLRMDense(cfg)
+
+    def loss_fn(dp, emb_outs, b):
+        n, y = b
+        return bce_with_logits(dense.apply(dp, n, emb_outs), y)
+
+    state, num, labels = build_state(de, dense, cfg, emb_opt, tx,
+                                     table_sizes, jnp.float32, batch=batch)
+    step = make_hybrid_train_step(de, loss_fn, tx, emb_opt,
+                                  lr_schedule=0.005, with_metrics=True,
+                                  nan_guard=True)
+    rng = np.random.default_rng(0)
+    cats = [jnp.asarray(power_law_ids(rng, s, (batch,)), jnp.int32)
+            for s in table_sizes]
+    nan_labels = jnp.asarray(np.asarray(labels).copy())
+    nan_labels = nan_labels.at[(0,) * nan_labels.ndim].set(jnp.nan)
+    steps = RESIL_STEPS
+    bad = steps // 2
+
+    def data(start):
+        for i in range(start, steps):
+            yield cats, (num, nan_labels if i == bad else labels)
+
+    with tempfile.TemporaryDirectory(prefix="detpu_bench_rec_") as tmp:
+        ck = os.path.join(tmp, "ck")
+        t0 = time.perf_counter()
+        res = run_resilient(step, state, data, de=de, checkpoint_dir=ck,
+                            checkpoint_every_steps=2, resume=True,
+                            emb_optimizer=emb_opt, dense_tx=tx,
+                            escalate_after=1, keep_last_n=2,
+                            metrics_interval=0)
+        wall = time.perf_counter() - t0
+    assert res.rollbacks == 1 and list(res.quarantined) == [bad], (
+        res.rollbacks, res.quarantined)
+    return {
+        "steps": steps,
+        "rollbacks": res.rollbacks,
+        "quarantined_batches": len(res.quarantined),
+        # the pure recovery cost: restoring the ring checkpoint (replayed
+        # steps are ordinary training steps and are priced as such)
+        "rollback_wall_time_s": res.rollback_time_s,
+        "drill_wall_time_s": round(wall, 3),
     }
 
 
@@ -974,6 +1044,29 @@ def run_convergence(param_dtype=jnp.float32, steps=CONV_STEPS,
                                   lr_schedule=0.01, param_dtype=param_dtype)
 
 
+def run_convergence_sgd(steps=CONV_STEPS, batch=CONV_BATCH):
+    """The SGD-only convergence capture (ROADMAP 1): the reference's
+    flagship recipe — plain SGD on BOTH halves — on the planted task.
+    Root-caused in docs/perf_tpu.md Round 9: the sparse path IS exact
+    plain SGD (PR 8 equivalence test) and the per-table cotangents flow
+    at the same magnitude as under Adam (the health sentinels measure
+    them), but the pairwise-product signal at DLRM's 1/sqrt(vocab) init
+    leaves every SGD-stable (lr, init-scale) combination pinned at the
+    numerical-only solution within probe budgets — task conditioning,
+    not a path defect. This capture records the recipe anyway so any
+    future conditioning fix (feature normalization, warmup, interaction
+    scaling) shows up as movement here; expect ~0.60 (the numerical-only
+    region) until then, vs the 0.636 ceiling and Adam's ~0.87."""
+    from distributed_embeddings_tpu.models.learnable import (
+        LearnableClicks, train_dlrm_convergence)
+
+    task = LearnableClicks([2000] * 8, num_numerical=4, seed=123, scale=1.2)
+    return train_dlrm_convergence(task, world_size=1, steps=steps,
+                                  batch=batch, embedding_dim=16,
+                                  optimizer="sgd", lr_schedule=4.0,
+                                  dense_lr=0.01)
+
+
 def run_input_pipeline(world=16, batches=6):
     """End-to-end input pipeline at the v5e-16 projection shapes: raw-binary
     reader -> ``pack_mp_inputs`` (the DLRM example's default input path,
@@ -1232,13 +1325,18 @@ def main():
         out["reshard"] = reshard
     resil = _guard("resilient_overhead", run_resilient_overhead)
     if resil is not None:
-        # nested record for the bench report; the two samples/s terms are
+        # nested record for the bench report; the throughput terms are
         # ALSO lifted to the top level so compare_bench's regression gate
         # sees them like any other throughput metric
         out["resilient_overhead"] = resil
         out["nanguard_samples_per_sec"] = resil["nanguard_samples_per_sec"]
         out["resilient_samples_per_sec"] = resil[
             "resilient_samples_per_sec"]
+        out["sentinel_samples_per_sec"] = resil[
+            "sentinel_samples_per_sec"]
+    recov = _guard("recovery", run_recovery)
+    if recov is not None:
+        out["recovery"] = recov
     conv = _guard("convergence", lambda: run_convergence(jnp.float32))
     # skip the bf16 variant when fp32 failed: its result would be dropped
     conv_bf16 = (_guard("convergence_bf16",
@@ -1254,6 +1352,19 @@ def main():
             "batch": CONV_BATCH,
             "bf16_params_auc_end": (round(conv_bf16[2], 4)
                                     if conv_bf16 else None),
+        }
+    conv_sgd = _guard("convergence_sgd", run_convergence_sgd)
+    if conv_sgd is not None:
+        # the reference's flagship recipe (plain SGD both halves) on the
+        # planted task — root-caused to a task-conditioning ceiling, not
+        # a sparse-path defect (docs/perf_tpu.md Round 9); recorded so a
+        # future conditioning fix shows up as movement
+        out["convergence_sgd"] = {
+            "recipe": "sgd_emb_lr4_dense_lr0.01",
+            "auc_start": round(conv_sgd[0], 4),
+            "auc_mid": round(conv_sgd[1], 4),
+            "auc_end": round(conv_sgd[2], 4),
+            "auc_numerical_only": 0.636,
         }
     # merge the sidecar's per-section statuses into the final record, so
     # the one JSON line also says which variants ran/failed/timed out

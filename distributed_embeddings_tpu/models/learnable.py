@@ -68,23 +68,38 @@ def train_dlrm_convergence(task: LearnableClicks, *, world_size: int = 1,
                            mesh=None, steps: int = 360, batch: int = 8192,
                            embedding_dim: int = 16, lr_schedule=0.01,
                            param_dtype=None, eval_n: int = 16384,
-                           seed: int = 0):
+                           seed: int = 0, optimizer: str = "adam",
+                           dense_lr=None, emb_init_scale=None):
     """Train DLRM on ``task`` through the FULL hybrid path and return
     ``(auc_start, auc_mid, auc_end)`` on a held-out draw.
 
     The one convergence driver shared by the bench (single chip) and the
-    slow tests (8-device CPU mesh) — sparse embedding optimizer
-    (:class:`~..parallel.SparseAdam`), optax Adam dense side, eval via
-    :func:`~..parallel.make_hybrid_eval_step` + exact AUC. Adam on both
-    sides matters: the pairwise-product signal needs normalized updates to
-    emerge from the tiny-uniform embedding init (plain SGD learns only the
-    linear numerical part; a dense-autodiff Adam control reaches the same
-    ~0.888 Bayes ceiling, so the sparse path is held to it)."""
+    slow tests (8-device CPU mesh) — sparse embedding optimizer, optax
+    dense side, eval via :func:`~..parallel.make_hybrid_eval_step` +
+    exact AUC.
+
+    ``optimizer="adam"`` (default): :class:`~..parallel.SparseAdam` +
+    ``optax.adam`` — the historical capture. ``optimizer="sgd"``:
+    :class:`~..parallel.SparseSGD` + ``optax.sgd``, the reference's
+    flagship recipe (its DLRM trains with plain SGD lr=24 to AUC
+    0.80248) and the ROADMAP 1 diagnostic subject: under the default
+    DLRM table init (uniform ``±1/sqrt(vocab)`` ≈ ±0.022 at vocab 2000)
+    the pairwise-product signal puts SGD at a saddle — gradients w.r.t.
+    one table's rows are proportional to the OTHER table's tiny rows, so
+    escape is multiplicative with rate ~ ``lr * |e|^2`` and lr=0.01
+    learns only the linear numerical part (AUC ~0.636). Raising the
+    embedding lr toward the reference's recipe (or the init scale via
+    ``emb_init_scale``, which multiplies the default initializer)
+    restores convergence; see ``docs/perf_tpu.md`` Round 9 for the
+    measured (lr, init) matrix.
+
+    ``dense_lr`` decouples the dense side's lr when the embedding lr is
+    cranked SGD-style (defaults to ``lr_schedule``)."""
     import jax
     import jax.numpy as jnp
     import optax
 
-    from ..parallel import (DistributedEmbedding, SparseAdam,
+    from ..parallel import (DistributedEmbedding, SparseAdam, SparseSGD,
                             init_hybrid_state, make_hybrid_eval_step,
                             make_hybrid_train_step)
     from ..utils import binary_auc
@@ -95,7 +110,15 @@ def train_dlrm_convergence(task: LearnableClicks, *, world_size: int = 1,
                      num_numerical_features=task.num_numerical,
                      bottom_mlp_dims=[2 * embedding_dim, embedding_dim],
                      top_mlp_dims=[64, 32, 1])
-    de = DistributedEmbedding(cfg.embedding_configs(),
+    emb_configs = cfg.embedding_configs()
+    if emb_init_scale is not None:
+        def scaled(base, s=float(emb_init_scale)):
+            return lambda key, shape, dtype=jnp.float32: (
+                s * base(key, shape, dtype))
+        for c in emb_configs:
+            c["embeddings_initializer"] = scaled(
+                c["embeddings_initializer"])
+    de = DistributedEmbedding(emb_configs,
                               world_size=world_size,
                               strategy="memory_balanced")
     dense = DLRMDense(cfg)
@@ -104,8 +127,24 @@ def train_dlrm_convergence(task: LearnableClicks, *, world_size: int = 1,
         jnp.zeros((2, task.num_numerical), jnp.float32),
         [jnp.zeros((2, embedding_dim), jnp.float32)
          for _ in task.table_sizes])
-    tx = optax.adam(lr_schedule)
-    emb_opt = SparseAdam()
+    if dense_lr is None:
+        dense_lr = lr_schedule
+    if optimizer == "adam":
+        tx = optax.adam(dense_lr)
+        emb_opt = SparseAdam()
+    elif optimizer == "sgd":
+        tx = optax.sgd(dense_lr)
+        emb_opt = SparseSGD()
+    elif optimizer == "mixed":
+        # dense Adam + embedding SparseSGD: isolates whether the SPARSE
+        # path learns under plain SGD when the dense half is not the
+        # bottleneck — the ROADMAP 1 control that separates "sparse-path
+        # defect" from "task conditioning starves the whole model"
+        tx = optax.adam(dense_lr)
+        emb_opt = SparseSGD()
+    else:
+        raise ValueError(f"optimizer must be 'adam' | 'sgd' | 'mixed', "
+                         f"got {optimizer!r}")
 
     def loss_fn(d, outs, batch_):
         num, y = batch_

@@ -31,6 +31,7 @@ from .sparse_optax import (
 from .resilient import (
     PREEMPT_EXIT_CODE,
     ResilientResult,
+    quarantine_ledger_path,
     resume_sentinel_path,
     run_resilient,
 )

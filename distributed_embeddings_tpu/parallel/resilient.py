@@ -23,14 +23,28 @@ the sharded tables (guard off) or spun forever (guard on, nobody watching).
   deterministically fast-forwarded (:func:`~..utils.data.fast_forward`)
   so no batch is replayed or skipped — an interrupted+resumed run
   reproduces the uninterrupted trajectory bit for bit.
-* **Non-finite escalation**: the on-device guard
-  (:func:`~.trainer.make_hybrid_train_step` with ``nan_guard``, default
-  ``DETPU_NANGUARD`` = on) skips poisoned updates with params bitwise
-  unchanged; this driver counts consecutive skips on the host (the step's
-  returned loss stays truthfully non-finite) and raises
-  :class:`~..utils.runtime.NonFiniteLossError` naming the last good step
-  after K (``DETPU_NANGUARD_K``, default 3) — after a final checkpoint of
-  the still-clean state.
+* **Non-finite escalation -> rollback-and-replay recovery**: the
+  on-device guard (:func:`~.trainer.make_hybrid_train_step` with
+  ``nan_guard``, default ``DETPU_NANGUARD`` = on) skips poisoned updates
+  with params bitwise unchanged; this driver counts consecutive skips on
+  the host (the step's returned loss stays truthfully non-finite) and,
+  after K (``DETPU_NANGUARD_K``, default 3), enters the supervised
+  recovery state machine instead of dying: restore the newest *healthy*
+  checkpoint generation predating the poisoned window (the
+  ``keep_last_n`` ring ``utils.checkpoint`` keeps beyond ``.prev``),
+  replay the window batch by batch under the guard, QUARANTINE exactly
+  the batches that come out non-finite (each is recorded in the
+  ``<dir>.quarantine.json`` ledger and never fed again; the step counter
+  is corrected so the trajectory equals a run whose stream never
+  contained them), and continue. Each skip/rollback names the unhealthy
+  tables via the per-table health sentinels
+  (:class:`~..utils.obs.TableHealthContract`). The old terminal
+  :class:`~..utils.runtime.NonFiniteLossError` still fires — with the
+  full quarantine ledger attached — once the ``DETPU_ROLLBACK_MAX``
+  retry budget or the ``DETPU_QUARANTINE_MAX`` quarantine budget is
+  exhausted (a fully-poisoned stream is not a transient window), no
+  healthy candidate predates the window, or recovery is impossible
+  (guard off, one-shot iterator, no checkpoint dir).
 * **Invalid-input enforcement**: under
   ``DistributedEmbedding(invalid_id_policy='raise')`` each batch is
   host-validated before dispatch (:meth:`~.dist_embedding.
@@ -49,6 +63,8 @@ embedding weights at the end (``examples/dlrm/main.py:246-248`` there).
 
 from __future__ import annotations
 
+import bisect
+import collections.abc
 import dataclasses
 import json
 import logging
@@ -58,12 +74,16 @@ import signal
 import sys
 import threading
 import time
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+import uuid
+
 from ..utils import envvars, obs, runtime
-from ..utils.checkpoint import restore_train_state, save_train_state
+from ..utils.checkpoint import (meta_run_id, previous_checkpoint_path,
+                                restore_train_state, rollback_candidates,
+                                save_train_state)
 from ..utils.data import fast_forward
 
 logger = logging.getLogger(__name__)
@@ -81,6 +101,119 @@ def resume_sentinel_path(checkpoint_dir: str) -> str:
     return checkpoint_dir.rstrip(os.sep) + ".resume.json"
 
 
+def quarantine_ledger_path(checkpoint_dir: str) -> str:
+    """Where the rollback-and-replay recovery persists its quarantine
+    ledger (beside the checkpoint directory, like the resume sentinel)."""
+    return checkpoint_dir.rstrip(os.sep) + ".quarantine.json"
+
+
+def _atomic_json(path: str, doc: Dict[str, Any]) -> None:
+    """Atomic JSON write (tmp + flush + fsync + rename) — the one
+    durability idiom behind the resume sentinel, the telemetry summary,
+    and the quarantine ledger."""
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class _QuarantineLedger:
+    """Persistent recovery state: the set of quarantined stream positions
+    and the rollback count. Written atomically on every change so the
+    retry budget and the skip-list survive preemption/restart — a resumed
+    run must neither re-feed a quarantined batch nor get a fresh rollback
+    budget to burn on the same poison."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self.quarantined: set = set()
+        self.rollbacks = 0
+
+    @classmethod
+    def load(cls, path: Optional[str]) -> "_QuarantineLedger":
+        led = cls(path)
+        if path and os.path.isfile(path):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    doc = json.load(f)
+                if not isinstance(doc, dict):
+                    raise ValueError(f"ledger is {type(doc).__name__}, "
+                                     "not an object")
+                led.quarantined = {int(x) for x in doc.get("quarantined",
+                                                           [])}
+                led.rollbacks = int(doc.get("rollbacks", 0))
+            except (OSError, json.JSONDecodeError, ValueError, TypeError):
+                logger.warning("quarantine ledger %s unreadable; starting "
+                               "fresh", path)
+        return led
+
+    def save(self, chief: bool = True) -> None:
+        if not self.path or not chief:
+            return
+        _atomic_json(self.path, {"quarantined": sorted(self.quarantined),
+                                 "rollbacks": self.rollbacks,
+                                 "time": time.time()})
+
+
+def _stream_pos_for_step(step: int, quarantined) -> int:
+    """Invert the step<->stream mapping: the stream position whose
+    (quarantine-filtered) prefix contains exactly ``step`` fed batches.
+    Quarantined batches occupy stream positions but are never fed, so
+    ``pos = step + |{q in ledger : q < pos}|`` — a monotone fixed point
+    reached in <= |ledger| iterations."""
+    qs = sorted(quarantined)
+    pos = step
+    while True:
+        nxt = step + bisect.bisect_left(qs, pos)
+        if nxt == pos:
+            return pos
+        pos = nxt
+
+
+def _poison_batch(batch):
+    """``DETPU_FAULT=nan@<pos>`` drill: NaN the first element of the
+    batch's first floating leaf — one rank's slice of the dense batch, so
+    the poison flows through the real loss into the on-device guard (the
+    pmean'd verdict makes every rank skip in lockstep)."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(batch)
+    out, done = [], False
+    for leaf in leaves:
+        if (not done and hasattr(leaf, "dtype")
+                and np.issubdtype(np.dtype(leaf.dtype), np.inexact)):
+            arr = np.array(leaf)
+            arr.reshape(-1)[0] = np.nan
+            leaf, done = arr, True
+        out.append(leaf)
+    if not done:
+        logger.warning("DETPU_FAULT=nan@: batch has no floating leaf to "
+                       "poison")
+    return jax.tree.unflatten(treedef, out)
+
+
+def _corrupt_ids(cat_inputs):
+    """``DETPU_FAULT=badbatch@<pos>`` drill: scramble the first integer
+    leaf of the categorical inputs to strictly negative ids — a garbled
+    batch every ``invalid_id_policy`` must absorb (clamp/drop + a nonzero
+    ``invalid_id_count``) or escalate (``raise``)."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(cat_inputs)
+    out, done = [], False
+    for leaf in leaves:
+        if (not done and hasattr(leaf, "dtype")
+                and np.issubdtype(np.dtype(leaf.dtype), np.integer)):
+            leaf, done = -(np.abs(np.array(leaf)) + 1), True
+        out.append(leaf)
+    if not done:
+        logger.warning("DETPU_FAULT=badbatch@: inputs have no integer "
+                       "leaf to corrupt")
+    return jax.tree.unflatten(treedef, out)
+
+
 @dataclasses.dataclass
 class ResilientResult:
     """Outcome of one :func:`run_resilient` invocation."""
@@ -95,6 +228,9 @@ class ResilientResult:
     stop_reason: str           #: exhausted | preempted | on_step | until_step
     elapsed_s: float           #: wall-clock of the training loop
     telemetry: Any = None      #: final jit-carried telemetry state (if any)
+    rollbacks: int = 0         #: rollback-and-replay recoveries (ledger)
+    quarantined: Tuple[int, ...] = ()  #: quarantined stream positions
+    rollback_time_s: float = 0.0  #: wall-clock spent restoring rollbacks
 
 
 class _PreemptCatcher:
@@ -150,6 +286,10 @@ def run_resilient(step_fn: Callable, state, data, *,
                   mesh=None,
                   on_mismatch: Optional[str] = None,
                   escalate_after: Optional[int] = None,
+                  keep_last_n: Optional[int] = None,
+                  rollback_max: Optional[int] = None,
+                  quarantine_max: Optional[int] = None,
+                  health: Optional[obs.TableHealthContract] = None,
                   metrics_logger=None,
                   metrics_interval: int = 100,
                   on_step: Optional[Callable] = None,
@@ -203,10 +343,30 @@ def run_resilient(step_fn: Callable, state, data, *,
         After the re-shard point the run is checkpoint-CRC-deterministic
         again: two resumes onto the same shrunken mesh write identical
         checkpoints.
-      escalate_after: consecutive non-finite-loss steps before
-        :class:`~..utils.runtime.NonFiniteLossError`; default
-        ``DETPU_NANGUARD_K`` (3). The state is checkpointed first — under
-        the guard it still holds the last good values.
+      escalate_after: consecutive non-finite-loss steps before the
+        rollback-and-replay recovery engages (and, once its budgets are
+        exhausted, :class:`~..utils.runtime.NonFiniteLossError` fires);
+        default ``DETPU_NANGUARD_K`` (3). On a terminal escalation the
+        state is checkpointed first — under the guard it still holds the
+        last good values.
+      keep_last_n: checkpoint-ring size passed to
+        :func:`~..utils.checkpoint.save_train_state` — how many
+        generations beyond ``<dir>`` and ``<dir>.prev`` stay restorable
+        (the rollback's supply of known-good states). Default
+        ``DETPU_CKPT_RING`` (2).
+      rollback_max: rollback-and-replay attempts before the escalation
+        turns terminal; default ``DETPU_ROLLBACK_MAX`` (2). The count
+        persists in the quarantine ledger across preemption/resume.
+      quarantine_max: total batches the recovery may quarantine before
+        declaring the stream poisoned (terminal); default
+        ``DETPU_QUARANTINE_MAX`` (8).
+      health: per-table numerical health contract
+        (:class:`~..utils.obs.TableHealthContract`) evaluated on every
+        guard-skipped instrumented step — its violations (and the table
+        ids they name) ride the warning logs and the
+        ``training_rollback`` / ``batch_quarantined`` recovery events.
+        Default: the env-configured contract
+        (:func:`~..utils.obs.default_health_contract`).
       metrics_logger: chief-side :class:`~..utils.obs.MetricsLogger`; when
         the step returns metrics, every process joins the collective
         :func:`~..utils.obs.fetch_metrics` each ``metrics_interval`` steps
@@ -249,6 +409,18 @@ def run_resilient(step_fn: Callable, state, data, *,
         escalate_after = obs.nanguard_escalation_k()
     if on_mismatch is None:
         on_mismatch = envvars.get("DETPU_ON_MISMATCH")
+    if keep_last_n is None:
+        keep_last_n = envvars.get_int("DETPU_CKPT_RING")
+    if rollback_max is None:
+        rollback_max = envvars.get_int("DETPU_ROLLBACK_MAX")
+    if quarantine_max is None:
+        quarantine_max = envvars.get_int("DETPU_QUARANTINE_MAX")
+    if health is None:
+        health = obs.default_health_contract()
+    # rollback needs to re-position the stream: a one-shot iterator that
+    # is already being consumed cannot be replayed
+    can_replay = (callable(data) or hasattr(data, "iter_from")
+                  or not isinstance(data, collections.abc.Iterator))
 
     if is_chief is None:
         def _chief() -> bool:
@@ -267,6 +439,34 @@ def run_resilient(step_fn: Callable, state, data, *,
     have_ckpt = checkpoint_dir is not None and (
         os.path.isfile(ckpt_meta)
         or os.path.isdir(checkpoint_dir + ".prev"))
+    # the quarantine ledger belongs to the checkpointed RUN: load it only
+    # on an actual resume — a fresh run (resume=False) in a dirty
+    # directory must not inherit stale skip positions or a spent budget
+    ledger_path = (quarantine_ledger_path(checkpoint_dir)
+                   if checkpoint_dir else None)
+    run_id: Optional[str] = None
+    if resume and have_ckpt:
+        ledger = _QuarantineLedger.load(ledger_path)
+        # a resume CONTINUES the checkpointed run's lineage: inherit its
+        # id so that run's generations stay valid rollback candidates
+        for p in (checkpoint_dir,
+                  previous_checkpoint_path(checkpoint_dir)):
+            run_id = meta_run_id(p)
+            if run_id is not None:
+                break
+    else:
+        ledger = _QuarantineLedger(ledger_path)
+        if ledger_path and os.path.isfile(ledger_path) and _chief():
+            # a previous run's ledger in this directory: DELETE it, or
+            # this run's own later resume would inherit the stale skip
+            # positions and spent rollback budget
+            os.remove(ledger_path)
+    if run_id is None:
+        # fresh lineage (or a pre-lineage checkpoint): every save below
+        # stamps it, and the rollback refuses candidates from any OTHER
+        # lineage — a fresh run in a dirty directory must never restore
+        # a previous run's parameters
+        run_id = uuid.uuid4().hex
     if resume and have_ckpt:
         if emb_optimizer is None or dense_tx is None:
             raise ValueError(
@@ -302,7 +502,6 @@ def run_resilient(step_fn: Callable, state, data, *,
                 telemetry_path + ".state.npz", telemetry_state)
 
     start_step = int(state.step)
-    batches = fast_forward(data, start_step)
 
     saves = 0
     last_save_t = time.monotonic()
@@ -314,12 +513,7 @@ def run_resilient(step_fn: Callable, state, data, *,
         from ..analysis import telemetry as tel
         try:
             summary = tel.summarize_telemetry(de, telemetry_state)
-            tmp = telemetry_path + ".tmp"
-            with open(tmp, "w", encoding="utf-8") as f:
-                json.dump(dict(summary, time=time.time()), f)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, telemetry_path)
+            _atomic_json(telemetry_path, dict(summary, time=time.time()))
             tel.save_telemetry_state(_telemetry_state_path(),
                                      telemetry_state)
         except Exception:  # noqa: BLE001 - telemetry is auxiliary: a flush
@@ -335,7 +529,8 @@ def run_resilient(step_fn: Callable, state, data, *,
     def _save():
         nonlocal saves, last_save_t
         runtime.fault_point("driver.save")
-        save_train_state(checkpoint_dir, de, state, is_chief=is_chief)
+        save_train_state(checkpoint_dir, de, state, is_chief=is_chief,
+                         keep_last_n=keep_last_n, run_id=run_id)
         _flush_telemetry()
         saves += 1
         last_save_t = time.monotonic()
@@ -348,136 +543,344 @@ def run_resilient(step_fn: Callable, state, data, *,
             if os.path.exists(path):
                 os.remove(path)
             return
-        tmp = path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(dict(fields, time=time.time()), f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        _atomic_json(path, dict(fields, time=time.time()))
 
     step = start_step - 1
     steps_run = 0
     skipped = 0
     consecutive = 0
+    bad_window: List[int] = []  # stream positions of the current streak
+    replay_until: Optional[int] = None  # recovery-replay high-water mark
     last_good = start_step - 1
     last_loss: Optional[float] = None
     preempted = False
     stop_reason = "exhausted"
+    rollback_time = 0.0
     check_ids = (de is not None
                  and (de.invalid_id_policy == "raise"
                       or de.ragged_overflow_raise))
     t0 = time.monotonic()
 
-    with _PreemptCatcher() as catcher:
-        for step, item in enumerate(batches, start=start_step):
-            if until_step is not None and step >= until_step:
-                stop_reason = "until_step"
-                break
-            runtime.fault_point("driver.step")
-            if runtime.preempt_step() == step:
-                # the preemption drill: a REAL self-SIGTERM at this step
-                # boundary, caught by the handler like any external one
-                os.kill(os.getpid(), signal.SIGTERM)
+    def _ledger_tail() -> str:
+        return (f". Quarantine ledger: {sorted(ledger.quarantined)} after "
+                f"{ledger.rollbacks} rollback(s)")
+
+    def _terminal(msg: str) -> runtime.NonFiniteLossError:
+        # park the (guard-clean) state before dying, like the
+        # pre-recovery escalation always did
+        if checkpoint_dir is not None:
+            _save()
+        err = runtime.NonFiniteLossError(msg + _ledger_tail())
+        err.quarantined = tuple(sorted(ledger.quarantined))
+        err.rollbacks = ledger.rollbacks
+        return err
+
+    def _attempt_rollback(cur_state, window):
+        """Restore the newest healthy checkpoint generation whose stream
+        position predates the poisoned window. Returns ``(state, dir)``
+        on success, ``(None, reason)`` when recovery is impossible."""
+        nonlocal rollback_time
+        if checkpoint_dir is None:
+            return None, "no checkpoint_dir to roll back to"
+        if not can_replay:
+            return None, ("data source is a one-shot iterator — pass a "
+                          "callable factory or an iter_from source to "
+                          "make the window replayable")
+        if not obs.nanguard_enabled():
+            return None, ("DETPU_NANGUARD=0: replayed updates would not "
+                          "be guarded, so a quarantined replay cannot "
+                          "be trusted")
+        if emb_optimizer is None or dense_tx is None:
+            return None, ("rollback needs emb_optimizer= and dense_tx= "
+                          "(the restore_train_state arguments)")
+        if ledger.rollbacks >= rollback_max:
+            return None, (f"rollback budget exhausted "
+                          f"({ledger.rollbacks}/{rollback_max}, "
+                          "DETPU_ROLLBACK_MAX)")
+        t_rb = time.monotonic()
+        tried = 0
+        for cand_step, cand in rollback_candidates(checkpoint_dir):
+            if cand_step is None:  # pre-ring format: position unknowable
+                continue
+            if meta_run_id(cand) != run_id:
+                # another run's leftover generation in this directory
+                # (fresh start over a dead run's checkpoints): restoring
+                # it would silently splice foreign parameters into this
+                # run's trajectory
+                continue
+            if _stream_pos_for_step(cand_step,
+                                    ledger.quarantined) > window[0]:
+                continue  # saved inside/after the window
+            tried += 1
+            runtime.fault_point("driver.rollback")
             try:
-                cat_inputs, batch = item
-            except (TypeError, ValueError) as e:
-                raise ValueError(
-                    "run_resilient data must yield (cat_inputs, batch) "
-                    f"pairs; got {type(item).__name__}") from e
-            if check_ids:
-                de.check_inputs(cat_inputs)
-
-            if telemetry_state is not None:
-                # telemetry-threaded steps return the carried state LAST
-                out = step_fn(state, cat_inputs, batch, telemetry_state)
-                telemetry_state = out[-1]
-                out = out[:-1]
-            else:
-                out = step_fn(state, cat_inputs, batch)
-            loss, state = out[0], out[1]
-            metrics = out[2] if len(out) > 2 else None
-            steps_run += 1
-
-            # ---- host view of the on-device guard ------------------------
-            last_loss = _as_float(loss)
-            skipped_now = not math.isfinite(last_loss)
-            if not skipped_now and metrics is not None \
-                    and "skipped_steps" in metrics:
-                # the guard can also skip on non-finite GRADIENT energy
-                # with a finite loss — the on-device flag is the
-                # authoritative verdict when the step is instrumented
-                skipped_now = float(
-                    np.asarray(metrics["skipped_steps"]).max()) > 0
-            if not skipped_now:
-                consecutive = 0
-                last_good = step
-            else:
-                consecutive += 1
-                skipped += 1
-                obs.counter_inc("nonfinite_steps")
+                restored = restore_train_state(
+                    cand, de, emb_optimizer, cur_state.dense_params,
+                    dense_tx, mesh=mesh, fallback=False,
+                    on_mismatch=on_mismatch)
+            except (runtime.CheckpointCorrupt,
+                    runtime.CheckpointMismatch) as e:
                 logger.warning(
-                    "run_resilient: non-finite step %d (loss %r, "
-                    "%d consecutive; guard %s)", step, last_loss,
-                    consecutive,
-                    "on" if obs.nanguard_enabled() else "OFF")
-                if consecutive >= escalate_after:
-                    if checkpoint_dir is not None:
-                        # under the guard the state still holds the last
-                        # good values — park them before dying
+                    "rollback: candidate %s unusable (%s); trying an "
+                    "older generation", cand, e)
+                continue
+            rollback_time += time.monotonic() - t_rb
+            return restored, cand
+        rollback_time += time.monotonic() - t_rb
+        return None, ("no healthy checkpoint generation predates the "
+                      f"poisoned window (tried {tried} candidate(s))")
+
+    def _record_recovery(kind: str, **payload):
+        obs.record_event(kind, **payload)
+        if metrics_logger is not None and _chief():
+            metrics_logger.log_event(kind, **payload)
+
+    with _PreemptCatcher() as catcher:
+        restart = True
+        while restart:
+            restart = False
+            step = int(state.step)  # host mirror of the update counter
+            # stream position and step counter decouple once batches are
+            # quarantined: position = step + |quarantined before it|
+            start_pos = _stream_pos_for_step(step, ledger.quarantined)
+            batches = fast_forward(data, start_pos)
+            for spos, item in enumerate(batches, start=start_pos):
+                if spos in ledger.quarantined:
+                    continue  # poisoned: never fed again, on any replay
+                cur = step  # ordinal of the step this batch would train
+                if until_step is not None and cur >= until_step:
+                    stop_reason = "until_step"
+                    break
+                runtime.fault_point("driver.step")
+                if runtime.preempt_step() == cur:
+                    # the preemption drill: a REAL self-SIGTERM at this
+                    # STEP boundary (counter ordinal, as documented —
+                    # unlike nan@/badbatch@, which target stream
+                    # positions so replays re-inject deterministically),
+                    # caught like any external one
+                    os.kill(os.getpid(), signal.SIGTERM)
+                try:
+                    cat_inputs, batch = item
+                except (TypeError, ValueError) as e:
+                    raise ValueError(
+                        "run_resilient data must yield (cat_inputs, "
+                        f"batch) pairs; got {type(item).__name__}") from e
+                if spos in runtime.nan_steps():
+                    batch = _poison_batch(batch)
+                if spos in runtime.badbatch_steps():
+                    cat_inputs = _corrupt_ids(cat_inputs)
+                if check_ids:
+                    de.check_inputs(cat_inputs)
+
+                if telemetry_state is not None:
+                    # telemetry-threaded steps return the carried state
+                    # LAST
+                    out = step_fn(state, cat_inputs, batch,
+                                  telemetry_state)
+                    telemetry_state = out[-1]
+                    out = out[:-1]
+                else:
+                    out = step_fn(state, cat_inputs, batch)
+                loss, state = out[0], out[1]
+                metrics = out[2] if len(out) > 2 else None
+                steps_run += 1
+
+                # ---- host view of the on-device guard -----------------
+                last_loss = _as_float(loss)
+                skipped_now = not math.isfinite(last_loss)
+                if not skipped_now and metrics is not None \
+                        and "skipped_steps" in metrics:
+                    # the guard can also skip on non-finite GRADIENT
+                    # energy with a finite loss — the on-device flag is
+                    # the authoritative verdict when instrumented
+                    skipped_now = float(
+                        np.asarray(metrics["skipped_steps"]).max()) > 0
+                quarantined_now = False
+                if not skipped_now:
+                    consecutive = 0
+                    bad_window = []
+                    last_good = cur
+                    step = cur + 1
+                    if replay_until is not None and spos >= replay_until:
+                        # the window replayed clean: recovery complete
+                        replay_until = None
+                        _record_recovery(
+                            "training_recovered", step=cur,
+                            quarantined=sorted(ledger.quarantined),
+                            rollbacks=ledger.rollbacks)
+                        logger.warning(
+                            "run_resilient: recovery complete at step %d "
+                            "— %d batch(es) quarantined over %d "
+                            "rollback(s); continuing", cur,
+                            len(ledger.quarantined), ledger.rollbacks)
+                elif replay_until is not None and spos <= replay_until:
+                    # ---- recovery replay: this batch is PROVEN poisoned
+                    # (restored state + guard say so) -> quarantine it
+                    quarantined_now = True
+                    skipped += 1
+                    if len(ledger.quarantined) >= quarantine_max:
+                        # undo this batch's counter advance BEFORE the
+                        # terminal save: the parked checkpoint must count
+                        # only fed batches (the batch itself stays out of
+                        # the full ledger — a resume retries it and fails
+                        # terminally again rather than silently skipping
+                        # an unrecorded position)
+                        state = state._replace(step=state.step - 1)
+                        raise _terminal(
+                            "stream is poisoned beyond the quarantine "
+                            f"budget (DETPU_QUARANTINE_MAX="
+                            f"{quarantine_max}): the batch at stream "
+                            f"position {spos} is non-finite too; last "
+                            f"good step: {last_good}")
+                    ledger.quarantined.add(spos)
+                    ledger.save(_chief())
+                    # the guard held params/optimizer state bitwise;
+                    # undo the counter advance so the trajectory equals
+                    # a stream that never contained this batch
+                    state = state._replace(step=state.step - 1)
+                    unhealthy = (obs.unhealthy_tables(metrics, health)
+                                 if metrics is not None else [])
+                    obs.counter_inc("quarantined_batches")
+                    _record_recovery(
+                        "batch_quarantined", stream_pos=spos, step=cur,
+                        loss=last_loss, unhealthy_tables=unhealthy,
+                        violations=(health.check(metrics)
+                                    if metrics is not None else []))
+                    logger.warning(
+                        "run_resilient: QUARANTINED batch at stream "
+                        "position %d (loss %r%s) — %d/%d quarantine "
+                        "slots used", spos, last_loss,
+                        (f"; unhealthy tables {unhealthy}" if unhealthy
+                         else ""), len(ledger.quarantined), quarantine_max)
+                    if spos >= replay_until:
+                        replay_until = None
+                        _record_recovery(
+                            "training_recovered", step=cur,
+                            quarantined=sorted(ledger.quarantined),
+                            rollbacks=ledger.rollbacks)
+                else:
+                    consecutive += 1
+                    skipped += 1
+                    bad_window.append(spos)
+                    step = cur + 1
+                    obs.counter_inc("nonfinite_steps")
+                    unhealthy = (obs.unhealthy_tables(metrics, health)
+                                 if metrics is not None else [])
+                    logger.warning(
+                        "run_resilient: non-finite step %d (loss %r, "
+                        "%d consecutive; guard %s%s)", cur, last_loss,
+                        consecutive,
+                        "on" if obs.nanguard_enabled() else "OFF",
+                        (f"; unhealthy tables {unhealthy}" if unhealthy
+                         else ""))
+                    if consecutive >= escalate_after:
+                        new_state, how = _attempt_rollback(state,
+                                                           bad_window)
+                        if new_state is None:
+                            raise _terminal(
+                                f"non-finite loss/gradients for "
+                                f"{consecutive} consecutive steps "
+                                f"(through step {cur}); last good step: "
+                                f"{last_good}. Params/optimizer state "
+                                "are held at the last good values"
+                                + (f" and checkpointed to "
+                                   f"{checkpoint_dir!r}"
+                                   if checkpoint_dir else "")
+                                + (" (DETPU_NANGUARD=0: updates were NOT "
+                                   "guarded — the saved state may be "
+                                   "poisoned)"
+                                   if not obs.nanguard_enabled() else "")
+                                + ". Rollback-and-replay could not "
+                                  f"recover: {how}")
+                        ledger.rollbacks += 1
+                        ledger.save(_chief())
+                        replay_until = bad_window[-1]
+                        payload = dict(
+                            escalated_at_step=cur,
+                            restored_step=int(new_state.step),
+                            candidate=how,
+                            window=[bad_window[0], bad_window[-1]],
+                            unhealthy_tables=unhealthy,
+                            rollbacks=ledger.rollbacks)
+                        _record_recovery("training_rollback", **payload)
+                        logger.warning(
+                            "run_resilient: NaN escalation at step %d — "
+                            "ROLLED BACK to %s (step %d); replaying "
+                            "stream window [%d, %d] under the guard to "
+                            "bisect the poison (rollback %d/%d)",
+                            cur, how, payload["restored_step"],
+                            bad_window[0], bad_window[-1],
+                            ledger.rollbacks, rollback_max)
+                        state = new_state
+                        if telemetry_state is not None \
+                                and telemetry_path is not None \
+                                and os.path.isfile(
+                                    _telemetry_state_path()):
+                            # rewind the carried telemetry to the last
+                            # flushed accumulation too, or the replayed
+                            # window double-counts into the hot-row
+                            # sketches (approximate — ids folded since
+                            # the last flush, incl. a later-quarantined
+                            # batch's, may remain counted; sketches are
+                            # monotone estimates by design)
+                            from ..analysis import telemetry as tel
+                            telemetry_state = tel.restore_telemetry_state(
+                                _telemetry_state_path(), telemetry_state)
+                        consecutive = 0
+                        bad_window = []
+                        restart = True
+                        break
+
+                # ---- metrics / escalations ---------------------------
+                # (quarantined batches are not part of the logical run:
+                # the clean-equivalent stream never contained them)
+                if metrics is not None and not quarantined_now:
+                    if de is not None and de.ragged_overflow_raise:
+                        overflow = float(np.asarray(
+                            metrics["id_overflow"]).sum())
+                        if overflow > 0:
+                            raise runtime.InvalidInputError(
+                                f"step {cur}: {int(overflow)} ragged "
+                                "id(s) overflowed their static capacity "
+                                "(ragged_overflow_raise)")
+                    if (metrics_interval
+                            and cur % metrics_interval == 0):
+                        host_metrics = obs.fetch_metrics(metrics)
+                        if metrics_logger is not None:
+                            metrics_logger.log_step(host_metrics,
+                                                    step=cur)
+
+                if (on_step is not None and not quarantined_now
+                        and on_step(cur, last_loss, metrics, state)):
+                    stop_reason = "on_step"
+                    break
+
+                # ---- checkpoint cadence ------------------------------
+                # suppressed mid-streak (consecutive > 0): the guard
+                # holds params at the last good values, so a save now
+                # adds nothing — and it would rotate the healthy
+                # pre-window generations out of the ring exactly when
+                # the rollback is about to need them
+                if (checkpoint_dir is not None and not catcher.fired
+                        and not quarantined_now and consecutive == 0):
+                    due_steps = (checkpoint_every_steps
+                                 and step % checkpoint_every_steps == 0)
+                    due_time = (checkpoint_every_s
+                                and time.monotonic() - last_save_t
+                                >= checkpoint_every_s)
+                    if due_steps or due_time:
                         _save()
-                    raise runtime.NonFiniteLossError(
-                        f"non-finite loss/gradients for {consecutive} "
-                        f"consecutive steps (through step {step}); last "
-                        "good step: "
-                        f"{last_good}. Params/optimizer state are held at "
-                        "the last good values"
-                        + (f" and checkpointed to {checkpoint_dir!r}"
-                           if checkpoint_dir else "")
-                        + (" (DETPU_NANGUARD=0: updates were NOT guarded "
-                           "— the saved state may be poisoned)"
-                           if not obs.nanguard_enabled() else ""))
 
-            # ---- metrics / escalations ----------------------------------
-            if metrics is not None:
-                if de is not None and de.ragged_overflow_raise:
-                    overflow = float(np.asarray(
-                        metrics["id_overflow"]).sum())
-                    if overflow > 0:
-                        raise runtime.InvalidInputError(
-                            f"step {step}: {int(overflow)} ragged id(s) "
-                            "overflowed their static capacity "
-                            "(ragged_overflow_raise)")
-                if (metrics_interval
-                        and step % metrics_interval == 0):
-                    host_metrics = obs.fetch_metrics(metrics)
-                    if metrics_logger is not None:
-                        metrics_logger.log_step(host_metrics, step=step)
-
-            if on_step is not None and on_step(step, last_loss, metrics,
-                                               state):
-                stop_reason = "on_step"
-                break
-
-            # ---- checkpoint cadence -------------------------------------
-            if checkpoint_dir is not None and not catcher.fired:
-                due_steps = (checkpoint_every_steps
-                             and (step + 1) % checkpoint_every_steps == 0)
-                due_time = (checkpoint_every_s
-                            and time.monotonic() - last_save_t
-                            >= checkpoint_every_s)
-                if due_steps or due_time:
-                    _save()
-
-            # ---- preemption: finish-step -> checkpoint -> sentinel ------
-            if catcher.fired:
-                preempted = True
-                stop_reason = "preempted"
-                if checkpoint_dir is not None:
-                    _save()
-                    _sentinel(True, step=int(state.step),
-                              signal=int(catcher.fired),
-                              reason="preempted")
-                break
+                # ---- preemption: finish-step -> checkpoint -> sentinel
+                if catcher.fired:
+                    preempted = True
+                    stop_reason = "preempted"
+                    if checkpoint_dir is not None:
+                        _save()
+                        _sentinel(True, step=int(state.step),
+                                  signal=int(catcher.fired),
+                                  reason="preempted")
+                    break
 
     elapsed = time.monotonic() - t0
     if not preempted:
@@ -494,7 +897,9 @@ def run_resilient(step_fn: Callable, state, data, *,
         preempted=preempted, skipped_steps=skipped,
         checkpoints_saved=saves, last_loss=last_loss,
         stop_reason=stop_reason, elapsed_s=elapsed,
-        telemetry=telemetry_state)
+        telemetry=telemetry_state, rollbacks=ledger.rollbacks,
+        quarantined=tuple(sorted(ledger.quarantined)),
+        rollback_time_s=round(rollback_time, 4))
     if preempted and exit_on_preempt and checkpoint_dir is not None:
         # exit code 83 asserts "checkpointed, requeue me" — only true
         # when a checkpoint dir exists; an uncheckpointed preemption

@@ -42,6 +42,48 @@ def _sq_sum(tree) -> jax.Array:
         tree, jnp.float32(0.0))
 
 
+def _table_sentinels(de, out_grads, lr):
+    """Per-table numerical health sentinels, computed from this device's
+    embedding cotangents (O(ids) — never a slab-wide pass): the three
+    ``table_*`` entries of :data:`~..utils.obs.STEP_METRIC_KEYS`, each
+    ``[1, n_tables]`` so ``out_specs=P(axis)`` stacks them to
+    ``[world, n_tables]``. The cotangent is what the sparse backward
+    scatters into the slab (times ``lr/world`` for the linear SGD path),
+    so a non-finite or exploding entry here IS the row update that would
+    have poisoned — or did poison — the named table. Inputs sharing a
+    table (``input_table_map``) fold into that table's entry; the update
+    bound uses the ``1/world`` pre-scale :meth:`~.dist_embedding.
+    DistributedEmbedding.sparse_apply_gradients` defaults to."""
+    n_tables = len(de.strategy.global_configs)
+    tmap = de.strategy.input_table_map
+    per_input = []
+    for g in out_grads:
+        g32 = g.astype(jnp.float32)
+        per_input.append((jnp.sum(jnp.square(g32)),
+                          jnp.max(jnp.abs(g32)),
+                          jnp.sum(jnp.logical_not(jnp.isfinite(g32)),
+                                  dtype=jnp.int32)))
+    # a device-varying REAL zero (shard_map vma): tables with no input
+    # still need entries, and ``x * 0.0`` would be NaN exactly when the
+    # cotangent is — the case these sentinels exist to count
+    zvar = de._vary(jnp.float32(0.0))
+    sq, mx, nf = [], [], []
+    for t in range(n_tables):
+        mine = [per_input[i] for i, tt in enumerate(tmap) if tt == t]
+        sq.append(sum((m[0] for m in mine), zvar))
+        mx.append(jnp.maximum(zvar,
+                              jnp.stack([m[1] for m in mine]).max())
+                  if mine else zvar)
+        nf.append(sum((m[2].astype(jnp.float32) for m in mine), zvar))
+    scale = jnp.float32(lr) / de.world_size
+    return {
+        "table_grad_norm": jnp.sqrt(jnp.stack(sq)).reshape(1, n_tables),
+        "table_update_maxabs": (jnp.abs(scale)
+                                * jnp.stack(mx)).reshape(1, n_tables),
+        "table_nonfinite": jnp.stack(nf).reshape(1, n_tables),
+    }
+
+
 def _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer, lr_schedule,
                        state, cat_inputs, batch, with_metrics=False,
                        nan_guard=False, telemetry_cfg=None, telem=None):
@@ -147,6 +189,11 @@ def _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer, lr_schedule,
                 else (loss, new_state))
     metrics = de.step_metrics(
         res, out_dtype=out_grads[0].dtype if out_grads else None)
+    with obs.scope("health_sentinels"):
+        # per-table numerical health, next to the nan-guard: names WHICH
+        # table's cotangents went non-finite/exploded (the recovery log's
+        # "table 3 went unhealthy at step k", not just "step k skipped")
+        metrics.update(_table_sentinels(de, out_grads, lr))
     # out_grads are device-varying; the pmean'd loss / resolved dense
     # grads / replicated step are not — _vary marks them for P(axis) out
     metrics["emb_grad_norm"] = jnp.sqrt(_sq_sum(out_grads)).reshape(1)

@@ -4,7 +4,8 @@ entry points."""
 
 from . import obs, runtime
 from .checkpoint import (previous_checkpoint_path, reshard_checkpoint,
-                         restore_train_state, save_train_state,
+                         restore_train_state, ring_dir, ring_entries,
+                         rollback_candidates, save_train_state,
                          validate_checkpoint_model, verify_checkpoint)
 from .data import DummyDataset, RawBinaryDataset, fast_forward, power_law_ids
 from .metrics import binary_auc

@@ -169,11 +169,111 @@ def previous_checkpoint_path(path: str) -> str:
     return path.rstrip(os.sep) + ".prev"
 
 
+def ring_dir(path: str) -> str:
+    """Directory holding the checkpoint ring (last-good checkpoints older
+    than ``<path>.prev``), one subdirectory per retained save."""
+    return path.rstrip(os.sep) + ".ring"
+
+
+def _meta_field(path: str, key: str):
+    """One field of a checkpoint's manifest (``None`` when the manifest
+    is unreadable or the field absent)."""
+    try:
+        with open(os.path.join(path, "meta.json"), encoding="utf-8") as f:
+            return json.load(f).get(key)
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+        return None
+
+
+def _meta_step(path: str) -> Optional[int]:
+    """The ``step`` a checkpoint's manifest records (``None`` for
+    pre-ring checkpoints or an unreadable manifest)."""
+    step = _meta_field(path, "step")
+    return int(step) if step is not None else None
+
+
+def meta_run_id(path: str) -> Optional[str]:
+    """The run-lineage id a checkpoint's manifest records (``None`` for
+    pre-lineage checkpoints). The resilient driver stamps every save
+    with its lineage (fresh runs mint one, resumes inherit the restored
+    checkpoint's) and the rollback refuses candidates from a DIFFERENT
+    lineage — a fresh run in a dirty directory must never roll back into
+    a previous run's parameters."""
+    rid = _meta_field(path, "run_id")
+    return str(rid) if rid is not None else None
+
+
+def ring_entries(path: str) -> list:
+    """The checkpoint ring of ``path``, newest first: ``[(step, dir),
+    ...]``. Entries are listed, not validated — a rollback consumer CRC-
+    verifies the one it picks (:func:`verify_checkpoint`) and moves on to
+    the next on corruption."""
+    d = ring_dir(path)
+    if not os.path.isdir(d):
+        return []
+    out = []
+    for name in os.listdir(d):
+        if not name.startswith("step_"):
+            continue
+        try:
+            step = int(name[len("step_"):])
+        except ValueError:
+            continue
+        out.append((step, os.path.join(d, name)))
+    out.sort(key=lambda e: e[0], reverse=True)
+    return out
+
+
+def prune_ring(path: str, keep_last_n: int) -> None:
+    """Drop the oldest ring entries beyond ``keep_last_n``."""
+    for _, entry in ring_entries(path)[max(0, keep_last_n):]:
+        shutil.rmtree(entry, ignore_errors=True)
+
+
+def rollback_candidates(path: str) -> list:
+    """Every restorable checkpoint generation of ``path``, newest first:
+    ``[(step, dir), ...]`` across ``path`` itself, ``<path>.prev``, and
+    the ring. ``step`` is the manifest-recorded step counter (``None``
+    for pre-ring checkpoints, which a step-aware rollback skips). Nothing
+    is CRC-validated here — the consumer verifies its pick."""
+    out = []
+    for p in (path, previous_checkpoint_path(path)):
+        if os.path.isfile(os.path.join(p, "meta.json")):
+            out.append((_meta_step(p), p))
+    out.extend(ring_entries(path))
+    # newest first; step-less (pre-ring) checkpoints sort last
+    out.sort(key=lambda e: (e[0] is not None, e[0] or 0), reverse=True)
+    return out
+
+
+def _archive_to_ring(path: str, prev: str, keep_last_n: int) -> None:
+    """Move the about-to-be-deleted second-newest checkpoint (``prev``)
+    into the ring instead of dropping it, then prune. Checkpoints whose
+    manifest predates step recording cannot be placed in the ring (their
+    position is unknowable) and are dropped as before."""
+    step = _meta_step(prev)
+    if step is None:
+        logger.debug("checkpoint ring: %s has no recorded step "
+                     "(pre-ring format); dropping instead of archiving",
+                     prev)
+        shutil.rmtree(prev)
+        return
+    entry = os.path.join(ring_dir(path), f"step_{step:012d}")
+    os.makedirs(ring_dir(path), exist_ok=True)
+    if os.path.isdir(entry):  # same-step re-save: newest wins
+        shutil.rmtree(entry)
+    os.replace(prev, entry)
+    prune_ring(path, keep_last_n)
+
+
 def _commit_staging(staging: str, path: str,
-                    keep_previous: bool = True) -> None:
+                    keep_previous: bool = True, ring_n: int = 0) -> None:
     """Swap a fully written staging directory into ``path`` (one directory
     rename; the displaced valid checkpoint survives at ``<path>.prev``
-    when ``keep_previous``), then honor a ``DETPU_FAULT=corrupt@ckpt``
+    when ``keep_previous``, and with ``ring_n > 0`` the checkpoint THAT
+    displaces — the former ``.prev`` — rotates into ``<path>.ring/``
+    instead of being deleted, keeping the newest ``ring_n`` generations
+    restorable), then honor a ``DETPU_FAULT=corrupt@ckpt``
     drill by flipping bytes mid-file in the committed checkpoint's first
     table shard — AFTER the commit, so the manifest certifies a file the
     disk then silently diverges from (the scenario CRC validation
@@ -184,7 +284,11 @@ def _commit_staging(staging: str, path: str,
         if keep_previous and os.path.isfile(
                 os.path.join(path, "meta.json")):
             if os.path.isdir(prev):
-                shutil.rmtree(prev)
+                if ring_n > 0 and os.path.isfile(
+                        os.path.join(prev, "meta.json")):
+                    _archive_to_ring(path, prev, ring_n)
+                else:
+                    shutil.rmtree(prev)
             os.replace(path, prev)
         else:  # invalid leftovers (or fallback disabled): drop them
             shutil.rmtree(path)
@@ -370,7 +474,9 @@ def _components(opt_state, params):
 
 def save_train_state(path: str, de, state: HybridTrainState,
                      is_chief: Optional[bool] = None,
-                     keep_previous: bool = True) -> None:
+                     keep_previous: bool = True,
+                     keep_last_n: int = 0,
+                     run_id: Optional[str] = None) -> None:
     """Write the full train state under ``path`` (a directory), atomically.
 
     Every process must call this (the streamed table fetches are
@@ -384,7 +490,21 @@ def save_train_state(path: str, de, state: HybridTrainState,
     between the swap's two renames) absent with the old checkpoint whole
     at ``<path>.prev``, which restore's fallback picks up. With
     ``keep_previous`` (the default) the displaced checkpoint survives at
-    ``<path>.prev`` as the restore fallback."""
+    ``<path>.prev`` as the restore fallback.
+
+    ``keep_last_n`` > 0 additionally keeps a RING of older generations:
+    the checkpoint the swap would have deleted (the former ``.prev``)
+    rotates into ``<path>.ring/step_<step>`` and the ring is pruned to
+    the newest ``keep_last_n`` entries — so at any time up to
+    ``keep_last_n + 2`` whole checkpoints are restorable
+    (:func:`rollback_candidates`). This is the rollback-and-replay
+    recovery's supply of known-good states: when a NaN storm escalates,
+    the driver restores the newest HEALTHY entry predating the poisoned
+    batch window instead of dying.
+
+    ``run_id`` stamps the manifest with a run-lineage id
+    (:func:`meta_run_id`) so a rollback can tell this run's generations
+    from a previous run's leftovers in the same directory."""
     if is_chief is None:
         is_chief = jax.process_index() == 0
     staging = _staging_path(path)
@@ -432,6 +552,10 @@ def save_train_state(path: str, de, state: HybridTrainState,
             return str(jnp.dtype(next(iter(tree.values())).dtype).name)
 
         meta = {"num_tables": n_tables,
+                # the step counter at save time: lets the ring name its
+                # entries and the rollback pick a candidate that predates
+                # a poisoned batch window without opening dense.msgpack
+                "step": int(np.asarray(jax.device_get(state.step))),
                 # per-table (vocab, dim): lets restore reject a checkpoint
                 # that does not match the model with a named error instead
                 # of a scatter-shape traceback (CheckpointMismatch)
@@ -453,11 +577,17 @@ def save_train_state(path: str, de, state: HybridTrainState,
                 # per-file CRC32s, manifest written LAST: its presence
                 # certifies every other file hit the disk whole
                 "files": dict(manifest)}
+        if run_id is not None:
+            # run lineage: lets the rollback refuse another run's
+            # leftover generations in the same directory
+            meta["run_id"] = str(run_id)
         _atomic_file(os.path.join(staging, "meta.json"),
                      lambda f: f.write(json.dumps(meta).encode()))
         _fsync_dir(staging)
         # ---- commit: one directory swap; old checkpoint -> <path>.prev
-        _commit_staging(staging, path, keep_previous=keep_previous)
+        # (and the former .prev -> the ring, under keep_last_n)
+        _commit_staging(staging, path, keep_previous=keep_previous,
+                        ring_n=int(keep_last_n))
 
 
 def _aux_consensus(comp: Dict[str, Any]) -> float:

@@ -147,14 +147,49 @@ declare("DETPU_NANGUARD", default="1",
             "unguarded step")
 declare("DETPU_NANGUARD_K", default="3",
         doc="consecutive guard-skipped steps before the resilient driver "
-            "escalates NonFiniteLossError")
+            "enters rollback-and-replay recovery (and, once the rollback "
+            "budget is exhausted, escalates NonFiniteLossError)")
+
+# rollback-and-replay recovery (parallel/resilient.py + utils/checkpoint.py)
+declare("DETPU_CKPT_RING", default="2",
+        doc="ring size of last-good checkpoints kept BEYOND <dir> and "
+            "<dir>.prev (utils.checkpoint.save_train_state keep_last_n): "
+            "each save archives the displaced .prev under <dir>.ring/ and "
+            "prunes to this many entries; the rollback-and-replay recovery "
+            "restores the newest healthy entry predating the poisoned "
+            "window. 0 = no ring (the pre-ring layout)")
+declare("DETPU_ROLLBACK_MAX", default="2",
+        doc="rollback-and-replay attempts per resilient run before the "
+            "NaN escalation turns terminal (NonFiniteLossError with the "
+            "quarantine ledger attached); persisted in the ledger so the "
+            "budget survives preemption/resume")
+declare("DETPU_QUARANTINE_MAX", default="8",
+        doc="max batches the recovery may quarantine (total, across "
+            "rollbacks) before declaring the stream poisoned and raising "
+            "terminally — a transient bad window is quarantinable, a "
+            "fully-poisoned stream is not")
+
+# per-table numerical health sentinels (parallel/trainer.py + utils/obs.py)
+declare("DETPU_HEALTH_GRAD_NORM", default="0",
+        doc="per-table sparse-gradient L2-norm threshold for the health "
+            "contract (obs.TableHealthContract): a table whose "
+            "table_grad_norm exceeds it is named unhealthy in recovery "
+            "logs/events. <= 0 = disabled (non-finite counts are always "
+            "checked)")
+declare("DETPU_HEALTH_UPDATE_MAXABS", default="0",
+        doc="per-table row-update max-abs threshold for the health "
+            "contract; <= 0 = disabled")
 
 # fault injection + runtime probes (utils/runtime.py)
 declare("DETPU_FAULT", default="",
         doc="comma-separated fault injections: hang|slow|raise|die:<point>, "
-            "preempt@<step> (driver self-SIGTERM drill), or corrupt@ckpt "
+            "preempt@<step> (driver self-SIGTERM drill), corrupt@ckpt "
             "(flip bytes in each just-committed checkpoint shard so the "
-            "CRC manifest + .prev fallback are exercisable end to end)")
+            "CRC manifest + .prev fallback are exercisable end to end), "
+            "nan@<step> (poison one rank's loss at that batch — the NaN-"
+            "storm drill the rollback-and-replay recovery quarantines), or "
+            "badbatch@<step> (corrupt that input batch's categorical ids — "
+            "exercises the invalid-input policies end to end)")
 declare("DETPU_ON_MISMATCH", default="reshard",
         doc="resilient-driver restore policy when a checkpoint's recorded "
             "sharding plan/world size differs from the model's: 'reshard' "

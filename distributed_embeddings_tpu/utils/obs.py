@@ -40,6 +40,7 @@ counter/logger half works in processes that never load jax at all.
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import json
 import logging
 import os
@@ -63,9 +64,11 @@ NANGUARD_K_ENV = "DETPU_NANGUARD_K"
 
 #: Keys of the on-device step-metrics dict (a plain dict so it is a pytree
 #: without any registration, and JSON-serializable after a host fetch).
-#: Every value is a per-device ``[1]``-shaped array; under ``shard_map``
-#: with ``out_specs=P(axis)`` the per-device rows concatenate into a
-#: ``[world]`` per-rank vector (rank ``r``'s entry describes rank ``r``).
+#: Every value is a per-device ``[1]``-shaped array — except the three
+#: per-table health sentinels (``table_*``), which are ``[1, n_tables]``.
+#: Under ``shard_map`` with ``out_specs=P(axis)`` the per-device rows
+#: concatenate into a ``[world]`` per-rank vector (rank ``r``'s entry
+#: describes rank ``r``); the sentinels become ``[world, n_tables]``.
 STEP_METRIC_KEYS = (
     "ids_routed",        # live (non-padding) ids this rank received
     "id_overflow",       # ragged ids lost to static-capacity truncation
@@ -79,7 +82,18 @@ STEP_METRIC_KEYS = (
     "dense_grad_norm",   # L2 norm of the (averaged) dense gradient
     "skipped_steps",     # 1 when the non-finite guard skipped this step
     "step",              # step counter at the START of the step
+    # -- per-table numerical health sentinels ([1, n_tables] per device):
+    # computed from this device's per-table embedding cotangents inside
+    # the jitted step, so a recovery log can name WHICH table went
+    # unhealthy, not just the step (see TableHealthContract)
+    "table_grad_norm",      # per-table L2 norm of the sparse cotangents
+    "table_update_maxabs",  # per-table max |row update| (lr/world scaled)
+    "table_nonfinite",      # per-table count of non-finite cotangents
 )
+
+#: The per-table health-sentinel subset of :data:`STEP_METRIC_KEYS`.
+TABLE_HEALTH_KEYS = ("table_grad_norm", "table_update_maxabs",
+                     "table_nonfinite")
 
 
 def metrics_enabled() -> bool:
@@ -380,7 +394,10 @@ def summarize(metrics: Dict[str, Any]) -> Dict[str, Any]:
                  "out_a2a_bytes", "grad_a2a_bytes"):
             out[k] = float(v.sum())
         elif k in ("id_overflow", "out_pad_frac", "emb_grad_norm",
-                   "skipped_steps"):
+                   "skipped_steps") or k in TABLE_HEALTH_KEYS:
+            # table sentinels reduce to their worst (max) entry here;
+            # the per-table view stays available via
+            # TableHealthContract.violations_by_table / unhealthy_tables
             out[k] = float(v.max())
         else:
             out[k] = float(v[0])
@@ -388,6 +405,100 @@ def summarize(metrics: Dict[str, Any]) -> Dict[str, Any]:
             out[f"{k}_p50"] = float(np.percentile(v, 50))
             out[f"{k}_p95"] = float(np.percentile(v, 95))
     return out
+
+
+# ------------------------------------------- per-table health contracts
+
+
+@dataclasses.dataclass(frozen=True)
+class TableHealthContract:
+    """Declarative per-table numerical-health thresholds, audited against
+    the ``table_*`` step-metric sentinels the trainer computes inside the
+    jitted step — the recovery analogue of the plan-audit
+    ``PlanContract``: the contract is data, :meth:`check` returns
+    violations naming the offending table, and the resilient driver logs
+    them in every skip/rollback event so a NaN storm at step 400k names
+    *which table* went unhealthy, not just the step.
+
+    ``max_nonfinite`` is the hard contract (default 0: any non-finite
+    cotangent entry is unhealthy). The two magnitude thresholds default
+    from ``DETPU_HEALTH_GRAD_NORM`` / ``DETPU_HEALTH_UPDATE_MAXABS`` and
+    are disabled at ``<= 0`` — magnitude is workload-dependent, finiteness
+    is not."""
+
+    max_grad_norm: float = 0.0       # per-table L2; <= 0 disables
+    max_update_maxabs: float = 0.0   # per-table max |update|; <= 0 disables
+    max_nonfinite: int = 0           # per-table non-finite entry budget
+
+    def violations_by_table(self, metrics: Dict[str, Any]
+                            ) -> Dict[int, List[str]]:
+        """Structured contract check of one step-metrics dict (device
+        arrays or numpy; each sentinel ``[..., n_tables]``, reduced over
+        ranks here): ``{table_id: [violation message, ...]}``. Empty
+        dict = every table healthy. Metrics dicts without the sentinels
+        (pre-sentinel steps) report nothing. This is the machine-read
+        form (recovery events, :func:`unhealthy_tables`);
+        :meth:`check` renders it for logs."""
+        import numpy as np
+
+        out: Dict[int, List[str]] = {}
+
+        def per_table(key):
+            v = metrics.get(key)
+            if v is None:
+                return None
+            arr = np.asarray(v)
+            if arr.ndim == 0 or arr.size == 0:
+                return None
+            return arr.reshape(-1, arr.shape[-1])
+
+        nf = per_table("table_nonfinite")
+        if nf is not None:
+            for t, n in enumerate(nf.sum(axis=0)):
+                if n > self.max_nonfinite:
+                    out.setdefault(t, []).append(
+                        f"{int(n)} non-finite sparse-gradient "
+                        f"entr{'y' if int(n) == 1 else 'ies'} (budget "
+                        f"{self.max_nonfinite})")
+        for key, cap, what in (
+                ("table_grad_norm", self.max_grad_norm, "grad L2 norm"),
+                ("table_update_maxabs", self.max_update_maxabs,
+                 "row-update max-abs")):
+            if cap is None or cap <= 0:
+                continue
+            v = per_table(key)
+            if v is None:
+                continue
+            for t, x in enumerate(v.max(axis=0)):
+                if not np.isfinite(x) or x > cap:
+                    out.setdefault(t, []).append(
+                        f"{what} {float(x):g} exceeds the {cap:g} "
+                        "contract")
+        return out
+
+    def check(self, metrics: Dict[str, Any]) -> List[str]:
+        """Human-readable violations (``"table <t>: <message>"``), table
+        order. Empty list = every table healthy."""
+        by_table = self.violations_by_table(metrics)
+        return [f"table {t}: {msg}"
+                for t in sorted(by_table) for msg in by_table[t]]
+
+
+def default_health_contract() -> TableHealthContract:
+    """The env-configured contract (``DETPU_HEALTH_GRAD_NORM`` /
+    ``DETPU_HEALTH_UPDATE_MAXABS``; non-finite budget always 0)."""
+    return TableHealthContract(
+        max_grad_norm=envvars.get_float("DETPU_HEALTH_GRAD_NORM"),
+        max_update_maxabs=envvars.get_float("DETPU_HEALTH_UPDATE_MAXABS"))
+
+
+def unhealthy_tables(metrics: Dict[str, Any],
+                     contract: Optional[TableHealthContract] = None
+                     ) -> List[int]:
+    """Sorted table ids the contract names unhealthy — the compact form
+    recovery events carry (structured, not parsed from log strings)."""
+    contract = contract or default_health_contract()
+    return sorted(contract.violations_by_table(metrics))
 
 
 def record_fault(point: str) -> None:

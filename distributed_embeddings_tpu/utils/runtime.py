@@ -140,9 +140,10 @@ def _fault_specs() -> List[Tuple[str, str, Optional[str]]]:
         item = item.strip()
         if not item:
             continue
-        if item.startswith("preempt@") or item == "corrupt@ckpt":
-            continue  # driver/checkpoint-level drills: see preempt_step()
-            # and corrupt_ckpt_requested()
+        if (item.startswith(("preempt@", "nan@", "badbatch@"))
+                or item == "corrupt@ckpt"):
+            continue  # driver/checkpoint-level drills: see preempt_step(),
+            # nan_steps(), badbatch_steps() and corrupt_ckpt_requested()
         parts = item.split(":", 2)
         if len(parts) < 2:
             logger.warning("ignoring malformed %s entry %r", FAULT_ENV, item)
@@ -167,6 +168,43 @@ def preempt_step() -> Optional[int]:
         except ValueError:
             logger.warning("ignoring malformed %s entry %r", FAULT_ENV, item)
     return None
+
+
+def _at_steps(prefix: str) -> Tuple[int, ...]:
+    """Step indices of every ``<prefix>@<step>`` entry in ``DETPU_FAULT``
+    (parsed per call like the other fault specs, so tests can flip the
+    variable at runtime). Malformed entries warn and are dropped."""
+    out = []
+    for item in (envvars.get(FAULT_ENV) or "").split(","):
+        item = item.strip()
+        if not item.startswith(prefix + "@"):
+            continue
+        try:
+            out.append(int(item.split("@", 1)[1]))
+        except ValueError:
+            logger.warning("ignoring malformed %s entry %r", FAULT_ENV, item)
+    return tuple(out)
+
+
+def nan_steps() -> Tuple[int, ...]:
+    """Batch indices of ``DETPU_FAULT=nan@<step>`` drills: at each of
+    those stream positions the resilient driver poisons ONE rank's slice
+    of the dense batch with a NaN before dispatch, so the poison flows
+    through the real forward into the loss and the on-device guard (and,
+    after ``DETPU_NANGUARD_K`` in a row, the rollback-and-replay
+    recovery) sees an organic non-finite step — the NaN-storm chaos
+    drill, deterministic on CPU."""
+    return _at_steps("nan")
+
+
+def badbatch_steps() -> Tuple[int, ...]:
+    """Batch indices of ``DETPU_FAULT=badbatch@<step>`` drills: at each
+    of those stream positions the resilient driver corrupts the batch's
+    categorical ids (scrambled negative/out-of-vocab values) before
+    dispatch — the garbled-input chaos drill the ``invalid_id_policy``
+    machinery (clamp / drop / raise + ``invalid_id_count``) must absorb
+    or escalate."""
+    return _at_steps("badbatch")
 
 
 def corrupt_ckpt_requested() -> bool:

@@ -47,6 +47,13 @@ flags.DEFINE_bool("resume", False,
 flags.DEFINE_integer("checkpoint_every_steps", 0,
                      "periodic checkpoint cadence for --checkpoint_dir "
                      "(0 = only at exit/preemption)")
+flags.DEFINE_integer("keep_last_n", None,
+                     "checkpoint-ring size beyond <dir> and <dir>.prev "
+                     "(rollback-and-replay recovery candidates); default "
+                     "DETPU_CKPT_RING (2)")
+flags.DEFINE_integer("rollback_max", None,
+                     "NaN-escalation rollback budget before the terminal "
+                     "NonFiniteLossError; default DETPU_ROLLBACK_MAX (2)")
 
 _GEN_BATCHES = 4  # distinct pre-generated batches, cycled
 
@@ -105,6 +112,8 @@ def main(_):
             step_fn, state, data, de=de,
             checkpoint_dir=FLAGS.checkpoint_dir,
             checkpoint_every_steps=FLAGS.checkpoint_every_steps,
+            keep_last_n=FLAGS.keep_last_n,
+            rollback_max=FLAGS.rollback_max,
             resume=FLAGS.resume, emb_optimizer=emb_opt, dense_tx=tx,
             mesh=mesh, exit_on_preempt=True)
         dt = (time.perf_counter() - t0) / max(res.steps_run, 1)

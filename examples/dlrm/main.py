@@ -100,6 +100,19 @@ flags.DEFINE_float("checkpoint_time_s", 0,
                    "also checkpoint when this much wall-clock passed "
                    "since the last save (bounds work lost to preemption; "
                    "0 = disabled)")
+flags.DEFINE_integer("keep_last_n", None,
+                     "checkpoint-ring size: how many generations beyond "
+                     "--save_state and its .prev stay restorable (the "
+                     "rollback-and-replay recovery's supply of known-good "
+                     "states); default DETPU_CKPT_RING (2)")
+flags.DEFINE_integer("rollback_max", None,
+                     "rollback-and-replay attempts on a NaN escalation "
+                     "before NonFiniteLossError turns terminal; default "
+                     "DETPU_ROLLBACK_MAX (2)")
+flags.DEFINE_integer("quarantine_max", None,
+                     "total batches the recovery may quarantine before "
+                     "declaring the stream poisoned; default "
+                     "DETPU_QUARANTINE_MAX (8)")
 flags.DEFINE_float("bootstrap_timeout_s", None,
                    "per-attempt deadline for the multi-host runtime join "
                    "(None = jax defaults); a slow coordinator is retried "
@@ -378,15 +391,21 @@ def main(_):
         return False
 
     # The self-healing driver: periodic/wall-clock checkpoints to
-    # --save_state, SIGTERM/SIGINT -> finish step + checkpoint + exit 83
-    # (resume sentinel beside the checkpoint dir), --resume auto-restores
-    # and fast-forwards the data stream, K consecutive non-finite losses
-    # escalate with the last good step named.
+    # --save_state (a keep_last_n ring of generations), SIGTERM/SIGINT ->
+    # finish step + checkpoint + exit 83 (resume sentinel beside the
+    # checkpoint dir), --resume auto-restores and fast-forwards the data
+    # stream, and K consecutive non-finite losses roll back to the newest
+    # healthy ring entry, quarantine the poisoned batch window (per-table
+    # sentinels naming the unhealthy table), and continue — terminal
+    # NonFiniteLossError only after the rollback budget.
     result = run_resilient(
         step_fn, state, data_source, de=de,
         checkpoint_dir=FLAGS.save_state,
         checkpoint_every_steps=FLAGS.checkpoint_interval,
         checkpoint_every_s=FLAGS.checkpoint_time_s,
+        keep_last_n=FLAGS.keep_last_n,
+        rollback_max=FLAGS.rollback_max,
+        quarantine_max=FLAGS.quarantine_max,
         resume=FLAGS.resume,
         emb_optimizer=emb_opt, dense_tx=tx, mesh=mesh,
         metrics_logger=metrics_log,

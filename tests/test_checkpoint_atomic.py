@@ -22,8 +22,8 @@ import pytest
 from distributed_embeddings_tpu.parallel import (
     DistributedEmbedding, SparseAdagrad, init_hybrid_state)
 from distributed_embeddings_tpu.utils import (
-    previous_checkpoint_path, restore_train_state, runtime,
-    save_train_state, verify_checkpoint)
+    previous_checkpoint_path, restore_train_state, ring_dir, ring_entries,
+    rollback_candidates, runtime, save_train_state, verify_checkpoint)
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -218,6 +218,71 @@ print("UNREACHABLE")
     restored = restore_train_state(path, de, emb_opt, dp, tx)
     got = float(np.asarray(de.get_weights(restored.emb_params)[0]).sum())
     assert got == pytest.approx(t0sum)  # v1 values, not the half-saved v2
+
+
+# ------------------------------------------------------- checkpoint ring
+
+
+def test_ring_retention_and_pruning(tmp_path):
+    """keep_last_n keeps a ring of older generations beyond .prev: each
+    save rotates the displaced .prev into <path>.ring/step_<n> and prunes
+    to the newest keep_last_n entries; every retained generation stays
+    CRC-whole and restorable."""
+    de, emb_opt, dp, tx, state = _tiny()
+    path = str(tmp_path / "ckpt")
+    st, gen3 = state, None
+    for i in range(6):  # saves at steps 0..5
+        save_train_state(path, de, st, keep_last_n=2)
+        if i == 3:
+            gen3 = np.asarray(de.get_weights(st.emb_params)[0]).copy()
+        st = _bump(st)
+    assert json.load(open(os.path.join(path, "meta.json")))["step"] == 5
+    entries = ring_entries(path)
+    assert [s for s, _ in entries] == [3, 2]  # newest first, pruned
+    for _, d in entries:
+        verify_checkpoint(d)
+    cands = rollback_candidates(path)
+    assert [s for s, _ in cands] == [5, 4, 3, 2]
+    assert cands[0][1] == path
+    assert cands[1][1] == previous_checkpoint_path(path)
+    # a ring entry restores like any checkpoint (step 3 generation)
+    restored = restore_train_state(cands[2][1], de, emb_opt, dp, tx)
+    assert int(restored.step) == 3
+    got = np.asarray(de.get_weights(restored.emb_params)[0])
+    np.testing.assert_array_equal(got, gen3)
+
+
+def test_ring_disabled_keeps_flat_layout(tmp_path):
+    """keep_last_n=0 (the library default) preserves the historical
+    path + .prev layout: no ring directory appears."""
+    de, emb_opt, dp, tx, state = _tiny()
+    path = str(tmp_path / "ckpt")
+    for _ in range(4):
+        save_train_state(path, de, state)
+        state = _bump(state)
+    assert not os.path.exists(ring_dir(path))
+    assert ring_entries(path) == []
+    # candidates still enumerate the flat layout, newest first
+    assert [s for s, _ in rollback_candidates(path)] == [3, 2]
+
+
+def test_ring_skips_prering_checkpoints(tmp_path):
+    """A .prev whose manifest predates step recording cannot be placed in
+    the ring (its position is unknowable): it is dropped as before, and
+    rollback_candidates sorts step-less generations last."""
+    de, emb_opt, dp, tx, state = _tiny()
+    path = str(tmp_path / "ckpt")
+    save_train_state(path, de, state, keep_last_n=2)
+    save_train_state(path, de, _bump(state), keep_last_n=2)
+    # erase the step from .prev's manifest (simulate a pre-ring save)
+    prev_meta = os.path.join(previous_checkpoint_path(path), "meta.json")
+    meta = json.load(open(prev_meta))
+    del meta["step"]
+    with open(prev_meta, "w") as f:
+        json.dump(meta, f)
+    save_train_state(path, de, _bump(_bump(state)), keep_last_n=2)
+    assert ring_entries(path) == []  # the step-less .prev was dropped
+    assert [s for s, _ in rollback_candidates(path)] == [2, 1]
 
 
 def test_checkpoint_mismatch_wrong_table_shape(tmp_path):

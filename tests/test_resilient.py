@@ -367,6 +367,289 @@ def test_on_step_stop_and_step_numbers():
     assert r.stop_reason == "on_step" and r.step == 4
 
 
+# --------------------------------------------- rollback-and-replay recovery
+
+
+def _stream(n, bad=(), drop=()):
+    """Deterministic stream factory: positions in ``bad`` yield a NaN'd
+    batch; positions in ``drop`` are removed entirely (the clean
+    equivalent a recovered run must match bit for bit)."""
+    def data(start):
+        idx = [i for i in range(n) if i not in drop]
+        for i in idx[start:]:
+            yield _batch(2000 + i, nan=(i in bad))
+    return data
+
+
+def _mesh(world):
+    return (Mesh(np.array(jax.devices()[:world]), ("data",))
+            if world > 1 else None)
+
+
+@pytest.mark.parametrize("world", [1, WORLD])
+def test_rollback_quarantine_crc_identical(tmp_path, world):
+    """Acceptance: a run that hits an injected bad batch rolls back to a
+    ring checkpoint, quarantines it (ledger + events), finishes, and its
+    final checkpoint is CRC-identical to an uninterrupted run trained on
+    the same stream with that batch skipped — single-process AND the
+    8-virtual-device mesh."""
+    from distributed_embeddings_tpu.utils import obs
+
+    obs.drain_events()  # test isolation: only THIS run's events below
+    de, tx, emb_opt, state, step = _build(world=world, nan_guard=True)
+    ck = str(tmp_path / "ck")
+    r = run_resilient(step, state, _stream(10, bad={5}), de=de,
+                      checkpoint_dir=ck, checkpoint_every_steps=2,
+                      resume=True, emb_optimizer=emb_opt, dense_tx=tx,
+                      mesh=_mesh(world), escalate_after=1, keep_last_n=2)
+    assert r.step == 9 and r.stop_reason == "exhausted"
+    assert r.rollbacks == 1 and r.quarantined == (5,)
+    assert r.rollback_time_s > 0
+    # the ledger survives on disk beside the checkpoint
+    ledger = json.load(open(ck + ".quarantine.json"))
+    assert ledger["quarantined"] == [5] and ledger["rollbacks"] == 1
+    # recovery recorded through obs.record_event (tentpole contract)
+    assert obs.drain_events("training_rollback")
+    assert obs.drain_events("batch_quarantined")
+    assert obs.drain_events("training_recovered")
+
+    de2, tx2, emb_opt2, state2, step2 = _build(world=world, nan_guard=True)
+    ref = str(tmp_path / "ref")
+    r2 = run_resilient(step2, state2, _stream(10, drop={5}), de=de2,
+                       checkpoint_dir=ref, checkpoint_every_steps=2,
+                       resume=True, emb_optimizer=emb_opt2, dense_tx=tx2,
+                       mesh=_mesh(world), keep_last_n=2)
+    assert r2.step == 9 and r2.rollbacks == 0
+    crc = json.load(open(os.path.join(ck, "meta.json")))["files"]
+    crc_ref = json.load(open(os.path.join(ref, "meta.json")))["files"]
+    assert crc == crc_ref
+
+
+def test_rollback_budget_exhaustion_attaches_ledger(tmp_path):
+    """A stream poisoned past the retry budget must still fire the old
+    terminal NonFiniteLossError — now with the quarantine ledger
+    attached (message + attributes)."""
+    de, tx, emb_opt, state, step = _build(nan_guard=True)
+    ck = str(tmp_path / "ck")
+    with pytest.raises(runtime.NonFiniteLossError,
+                       match="could not recover: rollback budget "
+                             "exhausted") as ei:
+        run_resilient(step, state, _stream(10, bad=set(range(4, 10))),
+                      de=de, checkpoint_dir=ck, checkpoint_every_steps=2,
+                      resume=True, emb_optimizer=emb_opt, dense_tx=tx,
+                      escalate_after=2, keep_last_n=2, rollback_max=1,
+                      quarantine_max=4)
+    assert "Quarantine ledger" in str(ei.value)
+    assert ei.value.quarantined == (4, 5)  # the first window, bisected
+    assert ei.value.rollbacks == 1
+    # terminal escalation still parks the clean state first
+    meta = json.load(open(os.path.join(ck, "meta.json")))
+    assert meta["num_tables"] == len(CONFIGS)
+
+
+def test_quarantine_budget_exhaustion(tmp_path):
+    """DETPU_QUARANTINE_MAX bounds how much history the recovery may
+    rewrite: one slot means the second poisoned batch in the window is
+    terminal."""
+    de, tx, emb_opt, state, step = _build(nan_guard=True)
+    ck = str(tmp_path / "ck")
+    with pytest.raises(runtime.NonFiniteLossError,
+                       match="poisoned beyond the quarantine budget"):
+        run_resilient(step, state, _stream(10, bad={4, 5}), de=de,
+                      checkpoint_dir=ck, checkpoint_every_steps=2,
+                      resume=True, emb_optimizer=emb_opt, dense_tx=tx,
+                      escalate_after=2, keep_last_n=2, quarantine_max=1)
+
+
+def test_rollback_without_checkpoint_dir_is_terminal():
+    """No checkpoint ring -> the escalation stays terminal (the
+    pre-recovery behavior), with the failure reason named."""
+    de, tx, emb_opt, state, step = _build(nan_guard=True)
+    with pytest.raises(runtime.NonFiniteLossError,
+                       match="no checkpoint_dir to roll back to"):
+        run_resilient(step, state, _stream(8, bad={2, 3, 4}), de=de,
+                      escalate_after=3)
+
+
+def test_nanguard_off_disables_rollback(tmp_path, monkeypatch):
+    """DETPU_NANGUARD=0: a replayed window cannot be trusted (updates
+    were not guarded), so recovery refuses and the escalation is
+    terminal with the poisoned-state warning intact."""
+    monkeypatch.setenv("DETPU_NANGUARD", "0")
+    de, tx, emb_opt, state, step = _build(nan_guard=False,
+                                          with_metrics=False)
+    ck = str(tmp_path / "ck")
+    with pytest.raises(runtime.NonFiniteLossError,
+                       match="DETPU_NANGUARD=0"):
+        run_resilient(step, state, _stream(8, bad={2, 3, 4}), de=de,
+                      checkpoint_dir=ck, checkpoint_every_steps=2,
+                      resume=True, emb_optimizer=emb_opt, dense_tx=tx,
+                      escalate_after=3, keep_last_n=2)
+
+
+def test_recovery_resume_preserves_ledger(tmp_path, monkeypatch):
+    """A run preempted AFTER a recovery must resume with the quarantine
+    ledger honored: the poisoned batch is never re-fed and the rollback
+    budget is not refreshed."""
+    de, tx, emb_opt, state, step = _build(nan_guard=True)
+    ck = str(tmp_path / "ck")
+    r1 = run_resilient(step, state, _stream(12, bad={3}), de=de,
+                       checkpoint_dir=ck, checkpoint_every_steps=2,
+                       resume=True, emb_optimizer=emb_opt, dense_tx=tx,
+                       escalate_after=1, keep_last_n=2, until_step=6)
+    assert r1.quarantined == (3,) and r1.step == 6
+    de2, tx2, emb_opt2, state2, step2 = _build(nan_guard=True)
+    r2 = run_resilient(step2, state2, _stream(12, bad={3}), de=de2,
+                       checkpoint_dir=ck, checkpoint_every_steps=2,
+                       resume=True, emb_optimizer=emb_opt2, dense_tx=tx2,
+                       escalate_after=1, keep_last_n=2)
+    assert r2.step == 11 and r2.rollbacks == 1  # ledger, not a re-rollback
+    assert r2.quarantined == (3,)
+    # clean-equivalent reference
+    de3, tx3, emb_opt3, state3, step3 = _build(nan_guard=True)
+    ref = str(tmp_path / "ref")
+    r3 = run_resilient(step3, state3, _stream(12, drop={3}), de=de3,
+                       checkpoint_dir=ref, checkpoint_every_steps=2,
+                       resume=True, emb_optimizer=emb_opt3, dense_tx=tx3,
+                       keep_last_n=2)
+    crc = json.load(open(os.path.join(ck, "meta.json")))["files"]
+    crc_ref = json.load(open(os.path.join(ref, "meta.json")))["files"]
+    assert crc == crc_ref
+
+
+def test_rollback_refuses_foreign_lineage_checkpoints(tmp_path):
+    """A fresh run (resume=False) over a dead run's checkpoints must
+    never roll back into them: every save is stamped with a run-lineage
+    id, and candidates from another lineage are refused — the escalation
+    is terminal instead of silently splicing foreign parameters."""
+    de, tx, emb_opt, state, step = _build(nan_guard=True)
+    ck = str(tmp_path / "ck")
+    r1 = run_resilient(step, state, _stream(6), de=de, checkpoint_dir=ck,
+                       checkpoint_every_steps=2, resume=True,
+                       emb_optimizer=emb_opt, dense_tx=tx, keep_last_n=2)
+    assert r1.step == 6  # run A left generations behind
+    de2, tx2, emb_opt2, state2, step2 = _build(nan_guard=True)
+    with pytest.raises(runtime.NonFiniteLossError,
+                       match="no healthy checkpoint generation"):
+        run_resilient(step2, state2, _stream(6, bad={0, 1, 2}), de=de2,
+                      checkpoint_dir=ck, checkpoint_every_steps=2,
+                      resume=False, emb_optimizer=emb_opt2, dense_tx=tx2,
+                      escalate_after=3, keep_last_n=2)
+
+
+def test_fresh_run_clears_stale_ledger(tmp_path):
+    """resume=False in a dirty directory must DELETE a previous run's
+    quarantine ledger — otherwise this run's own later resume would
+    inherit stale skip positions and a spent rollback budget."""
+    from distributed_embeddings_tpu.parallel import quarantine_ledger_path
+
+    de, tx, emb_opt, state, step = _build(nan_guard=True)
+    ck = str(tmp_path / "ck")
+    r1 = run_resilient(step, state, _stream(8, bad={3}), de=de,
+                       checkpoint_dir=ck, checkpoint_every_steps=2,
+                       resume=True, emb_optimizer=emb_opt, dense_tx=tx,
+                       escalate_after=1, keep_last_n=2)
+    assert r1.quarantined == (3,)
+    assert os.path.isfile(quarantine_ledger_path(ck))
+    de2, tx2, emb_opt2, state2, step2 = _build(nan_guard=True)
+    r2 = run_resilient(step2, state2, _stream(8), de=de2,
+                       checkpoint_dir=ck, checkpoint_every_steps=2,
+                       resume=False, emb_optimizer=emb_opt2, dense_tx=tx2,
+                       keep_last_n=2)
+    assert r2.step == 8 and r2.quarantined == ()  # pos 3 fed normally
+    assert not os.path.isfile(quarantine_ledger_path(ck))
+
+
+def test_sentinels_name_unhealthy_table():
+    """Per-table health sentinels: a NaN entering through ONE table's
+    cotangent names exactly that table — in the metrics, the contract
+    check, and obs.unhealthy_tables."""
+    from distributed_embeddings_tpu.utils import obs
+
+    de = DistributedEmbedding(CONFIGS, world_size=1)
+    emb_opt, tx = SparseAdagrad(), optax.sgd(0.1)
+    state = init_hybrid_state(de, emb_opt, {"w": jnp.float32(0.5)}, tx,
+                              jax.random.key(0))
+
+    def loss_fn(dp, outs, batch):
+        # per-table coefficients: poisoning batch[:, t] NaNs only
+        # table t's cotangent
+        return sum(batch[:, i].mean() * jnp.mean(o)
+                   for i, o in enumerate(outs)) * dp["w"]
+
+    step = make_hybrid_train_step(de, loss_fn, tx, emb_opt,
+                                  with_metrics=True, nan_guard=True)
+    rng = np.random.default_rng(0)
+    cats = [jnp.asarray(rng.integers(0, c["input_dim"], 16), jnp.int32)
+            for c in CONFIGS]
+    y = jnp.asarray(rng.normal(size=(16, len(CONFIGS))), jnp.float32)
+    loss, state, m = step(state, cats, y.at[0, 2].set(jnp.nan))
+    assert int(np.asarray(m["skipped_steps"]).max()) == 1
+    nf = np.asarray(m["table_nonfinite"]).reshape(-1, len(CONFIGS))
+    assert (nf.sum(axis=0) > 0).tolist() == [
+        i == 2 for i in range(len(CONFIGS))]
+    assert obs.unhealthy_tables(m) == [2]
+    violations = obs.TableHealthContract().check(m)
+    assert len(violations) == 1 and violations[0].startswith("table 2:")
+    # healthy batch: clean bill, and a magnitude contract can also fire
+    loss2, state, m2 = step(state, cats, y)
+    assert obs.unhealthy_tables(m2) == []
+    tight = obs.TableHealthContract(max_grad_norm=1e-12)
+    assert len(tight.check(m2)) == len(CONFIGS)
+
+
+def test_nan_fault_injection_quarantines(tmp_path, monkeypatch):
+    """DETPU_FAULT=nan@<step> poisons the batch in-flight: the guard
+    skips organically and the recovery quarantines exactly that stream
+    position."""
+    de, tx, emb_opt, state, step = _build(nan_guard=True)
+    ck = str(tmp_path / "ck")
+    monkeypatch.setenv(runtime.FAULT_ENV, "nan@3")
+    r = run_resilient(step, state, _stream(8), de=de, checkpoint_dir=ck,
+                      checkpoint_every_steps=2, resume=True,
+                      emb_optimizer=emb_opt, dense_tx=tx,
+                      escalate_after=1, keep_last_n=2)
+    assert r.quarantined == (3,) and r.rollbacks == 1
+    assert r.step == 7  # 8 batches minus the quarantined one
+
+
+def test_badbatch_fault_injection_counts_invalid(monkeypatch):
+    """DETPU_FAULT=badbatch@<step> corrupts the categorical ids: under
+    the default clamp policy the run survives and the violation surfaces
+    in invalid_id_count; under 'raise' it escalates."""
+    de, tx, emb_opt, state, step = _build(nan_guard=True)
+    monkeypatch.setenv(runtime.FAULT_ENV, "badbatch@1")
+    seen = {}
+
+    def on_step(s, loss, metrics, st):
+        seen[s] = int(np.asarray(metrics["invalid_id_count"]).sum())
+        return False
+
+    r = run_resilient(step, state, _stream(4), de=de, on_step=on_step,
+                      metrics_interval=0)
+    assert r.step == 4 and seen[1] > 0 and seen[0] == 0 and seen[2] == 0
+
+    de2, tx2, emb_opt2, state2, step2 = _build(
+        nan_guard=True, invalid_id_policy="raise")
+    with pytest.raises(runtime.InvalidInputError):
+        run_resilient(step2, state2, _stream(4), de=de2,
+                      metrics_interval=0)
+
+
+def test_nan_badbatch_fault_parsing(monkeypatch):
+    monkeypatch.setenv(runtime.FAULT_ENV, "nan@5,badbatch@7,raise:x:1")
+    assert runtime.nan_steps() == (5,)
+    assert runtime.badbatch_steps() == (7,)
+    # the @-entries must not confuse the mode:point parser
+    assert ("raise", "x", "1") in runtime._fault_specs()
+    monkeypatch.setenv(runtime.FAULT_ENV, "nan@2,nan@9")
+    assert runtime.nan_steps() == (2, 9)
+    monkeypatch.setenv(runtime.FAULT_ENV, "nan@oops")
+    assert runtime.nan_steps() == ()
+    monkeypatch.delenv(runtime.FAULT_ENV)
+    assert runtime.nan_steps() == () and runtime.badbatch_steps() == ()
+
+
 # ----------------------------------------------------- fast_forward / misc
 
 

@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Verify gate for the rollback-and-replay recovery (run by ``make
+verify``) — the NaN-storm chaos drill.
+
+CPU end-to-end, deterministic, no backend required beyond the CPU one:
+
+1. spawn a child training driver (tiny model, 12 batches through
+   ``parallel.resilient.run_resilient`` with a checkpoint ring) under
+   ``DETPU_FAULT=nan@5`` + ``DETPU_NANGUARD_K=1`` — batch 5's dense
+   coefficients are poisoned with a NaN in-flight, the on-device guard
+   skips the update, and the host driver must ROLL BACK to a ring
+   checkpoint, replay the window, QUARANTINE the poisoned batch, and run
+   to clean completion (exit 0, no human);
+2. assert the recovery artifacts: the quarantine ledger names stream
+   position 5, the metrics sidecar carries the ``training_rollback`` /
+   ``batch_quarantined`` / ``training_recovered`` events, and the
+   quarantine event's per-table health sentinels name table 0 (the one
+   whose cotangent the poisoned coefficient NaN'd) — the "which table
+   went unhealthy" acceptance;
+3. run the identical driver on the same stream WITH BATCH 5 REMOVED in a
+   fresh directory and assert both end at the same final step with
+   CRC-identical final checkpoints — recovery rewrites history to
+   exactly the stream-minus-poison trajectory.
+
+Exit 0 when the drill passes; 1 with a readable reason otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+STEPS = 12
+BAD = 5  # stream position the nan@ drill poisons
+
+# the loss gives each table its own batch coefficient, so the in-flight
+# NaN (first element of the dense batch) poisons ONLY table 0's
+# cotangent — the sentinel naming the table is load-bearing, not vacuous
+_CHILD = """
+import sys
+sys.path.insert(0, {repo!r})
+import jax, optax, numpy as np, jax.numpy as jnp
+jax.config.update('jax_platforms', 'cpu')
+from distributed_embeddings_tpu.parallel import (
+    DistributedEmbedding, SparseAdagrad, init_hybrid_state,
+    make_hybrid_train_step, run_resilient)
+from distributed_embeddings_tpu.utils import obs
+configs = [{{"input_dim": 16 + 3 * i, "output_dim": 4}} for i in range(4)]
+de = DistributedEmbedding(configs, world_size=1)
+emb_opt = SparseAdagrad()
+tx = optax.sgd(0.1)
+state = init_hybrid_state(de, emb_opt,
+                          {{"w": jnp.ones((4, 1), jnp.float32)}},
+                          tx, jax.random.key(0))
+def loss_fn(dp, outs, batch):
+    return sum(batch[:, i].mean() * jnp.mean(o)
+               for i, o in enumerate(outs)) * jnp.mean(dp["w"])
+def data(start):
+    idx = [i for i in range({steps}) if i not in {drop!r}]
+    for i in idx[start:]:
+        rng = np.random.default_rng(500 + i)
+        cats = [jnp.asarray(rng.integers(0, c["input_dim"], 8), jnp.int32)
+                for c in configs]
+        yield cats, jnp.asarray(rng.normal(size=(8, 4)), jnp.float32)
+step = make_hybrid_train_step(de, loss_fn, tx, emb_opt,
+                              with_metrics=True, nan_guard=True)
+logger = obs.MetricsLogger({sidecar!r})
+r = run_resilient(step, state, data, de=de, checkpoint_dir={ckpt!r},
+                  checkpoint_every_steps=2, resume=True,
+                  emb_optimizer=emb_opt, dense_tx=tx,
+                  metrics_logger=logger, metrics_interval=0)
+print("FINAL", r.step, "ROLLBACKS", r.rollbacks,
+      "QUARANTINED", list(r.quarantined), flush=True)
+"""
+
+
+def _run_child(ckpt, sidecar, fault=None, drop=()):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DETPU_FAULT", None)
+    env.pop("DETPU_OBS", None)
+    # one non-finite step is enough to engage recovery in the drill
+    env["DETPU_NANGUARD_K"] = "1"
+    env["DETPU_CKPT_RING"] = "2"
+    if fault:
+        env["DETPU_FAULT"] = fault
+    code = _CHILD.format(repo=REPO, ckpt=ckpt, sidecar=sidecar,
+                         steps=STEPS, drop=tuple(drop))
+    return subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=600)
+
+
+def _final_crcs(ckpt):
+    with open(os.path.join(ckpt, "meta.json"), encoding="utf-8") as f:
+        return json.load(f)["files"]
+
+
+def _events(sidecar, kind):
+    from distributed_embeddings_tpu.utils.obs import MetricsLogger
+
+    return [r for r in MetricsLogger.load(sidecar)
+            if r.get("section") == kind]
+
+
+def main() -> int:
+    errors = []
+    with tempfile.TemporaryDirectory(prefix="detpu_recovery_") as tmp:
+        ckpt = os.path.join(tmp, "ck")
+        sidecar = os.path.join(tmp, "metrics.jsonl")
+
+        # 1: the chaos run — poisoned batch -> rollback -> quarantine ->
+        # clean completion, unattended
+        p = _run_child(ckpt, sidecar, fault=f"nan@{BAD}")
+        if p.returncode != 0:
+            return _fail([f"chaos child failed rc={p.returncode}: "
+                          f"{(p.stderr or p.stdout).strip()[-800:]}"])
+        final = p.stdout.strip().splitlines()[-1].split()
+        if final[:2] != ["FINAL", str(STEPS - 1)]:
+            errors.append(
+                f"chaos child ended at {' '.join(final[:2])} — want FINAL "
+                f"{STEPS - 1} ({STEPS} batches minus 1 quarantined)")
+        if "ROLLBACKS 1" not in p.stdout:
+            errors.append(f"expected exactly one rollback: {final}")
+
+        # 2: the recovery artifacts
+        ledger_path = ckpt + ".quarantine.json"
+        if not os.path.isfile(ledger_path):
+            errors.append("no quarantine ledger written")
+        else:
+            with open(ledger_path, encoding="utf-8") as f:
+                ledger = json.load(f)
+            if ledger.get("quarantined") != [BAD]:
+                errors.append(f"ledger quarantined {ledger.get('quarantined')}"
+                              f" — want [{BAD}]")
+        rb = _events(sidecar, "training_rollback")
+        qu = _events(sidecar, "batch_quarantined")
+        rec = _events(sidecar, "training_recovered")
+        if not rb:
+            errors.append("no training_rollback event in the metrics "
+                          "sidecar")
+        if not rec:
+            errors.append("no training_recovered event in the metrics "
+                          "sidecar")
+        if not qu:
+            errors.append("no batch_quarantined event in the metrics "
+                          "sidecar")
+        else:
+            if qu[0].get("stream_pos") != BAD:
+                errors.append(f"quarantine event at stream_pos "
+                              f"{qu[0].get('stream_pos')} — want {BAD}")
+            unhealthy = qu[0].get("unhealthy_tables")
+            if unhealthy != [0]:
+                errors.append(
+                    f"quarantine event names unhealthy tables {unhealthy} "
+                    "— the poisoned coefficient NaNs exactly table 0's "
+                    "cotangent, so the sentinels must name [0]")
+
+        if errors:
+            return _fail(errors)
+
+        # 3: CRC-identity vs the clean run on the stream minus the poison
+        ref = os.path.join(tmp, "ref")
+        p2 = _run_child(ref, os.path.join(tmp, "ref.jsonl"), drop=(BAD,))
+        if p2.returncode != 0:
+            return _fail([f"reference child failed rc={p2.returncode}: "
+                          f"{(p2.stderr or p2.stdout).strip()[-800:]}"])
+        if f"FINAL {STEPS - 1}" not in p2.stdout:
+            errors.append(f"reference child did not reach step "
+                          f"{STEPS - 1}: {p2.stdout.strip()[-200:]}")
+        if not errors and _final_crcs(ckpt) != _final_crcs(ref):
+            errors.append(
+                "final checkpoints differ between the recovered run and "
+                "the clean run trained on the stream with the poisoned "
+                "batch removed (CRC manifests unequal) — recovery is not "
+                "trajectory-exact")
+    if errors:
+        return _fail(errors)
+    print(f"check_recovery: OK (nan@{BAD} storm rolled back to a ring "
+          f"checkpoint, quarantined the batch naming table 0, finished at "
+          f"step {STEPS - 1}, final state CRC-identical to the clean "
+          "stream-minus-poison run)")
+    return 0
+
+
+def _fail(errors) -> int:
+    for e in errors:
+        print(f"check_recovery: {e}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

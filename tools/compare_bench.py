@@ -51,6 +51,7 @@ THROUGHPUT_KEYS = (
     "input_pipeline_samples_per_sec",
     "nanguard_samples_per_sec",
     "resilient_samples_per_sec",
+    "sentinel_samples_per_sec",
     "telemetry_samples_per_sec",
 )
 # lower is better (ms-per-iter timings and byte budgets: a >threshold
