@@ -6,7 +6,7 @@ SHELL := /bin/bash
 .PHONY: all native test test-fast bench bench-diff clean pkg verify \
         lint plan-audit audit-step hlo-audit check-backend check-obs \
         check-obs-report check-resilience check-reshard check-recovery \
-        obs-report
+        check-streaming obs-report
 
 all: native
 
@@ -29,7 +29,8 @@ bench:
 # no-eager-backend shim), the observability gate, and the
 # preemption-recovery drill — run before shipping a round
 verify: lint plan-audit audit-step hlo-audit check-backend check-obs \
-        check-obs-report check-resilience check-reshard check-recovery
+        check-obs-report check-resilience check-reshard check-recovery \
+        check-streaming
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -104,6 +105,13 @@ check-reshard:
 # the stream-minus-poison run's final checkpoint bit for bit
 check-recovery:
 	python tools/check_recovery.py
+
+# streaming-vocab drill: oovflood a child streaming run (novel ids land
+# in the shared buckets, admissions fire), preempt + resume it, and
+# require 0 steady-state recompiles plus a final checkpoint (slot-map
+# aux included) CRC-identical to the uninterrupted run
+check-streaming:
+	python tools/check_streaming.py
 
 # optional regression gate: diff two BENCH records, nonzero exit on a >10%
 # throughput regression. Usage: make bench-diff OLD=BENCH_r04.json NEW=out.json

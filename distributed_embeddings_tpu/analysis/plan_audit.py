@@ -343,6 +343,10 @@ class RankBudget:
     a2a_buffer_bytes: int     # id block + fwd/bwd activation blocks
     total_bytes: int
     hbm_frac: float           # total / chip HBM
+    # jit-carried streaming-vocab state (slot map + freq + admission
+    # sketch per width slab with a dynamic table; parallel/streaming.py)
+    # — rank-uniform like the slabs, 0 for fully-static plans
+    streaming_state_bytes: int = 0
 
     def to_json(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -397,6 +401,7 @@ class PlanReport:
     imbalance_ratio: float
     out_pad_frac: float       # dead-column fraction of the padded exchange
     violations: List[str] = dataclasses.field(default_factory=list)
+    n_streaming_tables: int = 0  # dynamic-vocab tables in the plan
 
     @property
     def ok(self) -> bool:
@@ -429,7 +434,11 @@ class PlanReport:
             f"groups {self.n_groups} · l_max {self.l_max} · s_max "
             f"{self.s_max} · pad {self.out_pad_frac:.1%} · imbalance "
             f"{self.imbalance_ratio:.2f} · a2a/step "
-            f"{self.total_a2a_bytes_per_step / 1e6:.2f} MB/rank",
+            f"{self.total_a2a_bytes_per_step / 1e6:.2f} MB/rank"
+            + (f" · {self.n_streaming_tables} streaming table(s), "
+               f"{self.per_rank[0].streaming_state_bytes / 1e6:.2f} MB/rank "
+               "slot-map+sketch state"
+               if self.n_streaming_tables and self.per_rank else ""),
             "",
             "| rank | tables | live GB | alloc GB | opt GB | a2a buf GB "
             "| total GB | HBM frac |",
@@ -560,7 +569,8 @@ def audit_plan(target,
                dp_input: Optional[bool] = None,
                chip: str = "v5e",
                label: Optional[str] = None,
-               contract: Optional[PlanContract] = None) -> PlanReport:
+               contract: Optional[PlanContract] = None,
+               streaming_config=None) -> PlanReport:
     """Price a plan without building it.
 
     Args:
@@ -583,6 +593,14 @@ def audit_plan(target,
       contract: checked into ``report.violations`` when given
         (:func:`default_contract` is NOT applied implicitly — an audit
         is a report first, a gate only when asked).
+      streaming_config: admission-sketch geometry for pricing
+        streaming-table state — anything carrying ``.depth`` and
+        ``.buckets`` (a :class:`~..parallel.streaming.StreamingConfig`;
+        duck-typed so this module stays jax-free). Default: the
+        ``DETPU_ADMIT_SKETCH_*`` env policy. Pass the SAME config the
+        step builder gets via ``dynamic=`` or the per-rank
+        ``streaming_state_bytes`` under-/over-bills a non-default
+        sketch.
 
     Nothing executes and nothing is materialized: the heaviest object
     built is the executor's numpy plan tensors (``[world, n]`` per
@@ -648,10 +666,33 @@ def audit_plan(target,
         for c in cfgs:
             live_rank[r] += int(c["input_dim"]) * int(c["output_dim"]) * p_isz
 
+    # streaming-vocab carried state: slot map + frequency record (one
+    # int32 each per logical slab row) + the admission sketch, for every
+    # width slab holding a dynamic table (parallel/streaming.py). The
+    # slab + shared-bucket ROWS are already priced above (a streaming
+    # table declares input_dim = capacity + buckets); this is the extra
+    # jit-carried state the dynamic mode adds to the per-rank HBM bill.
+    stream_tids = [t for t, c in enumerate(strategy.global_configs)
+                   if c.get("streaming")]
+    stream_bytes = 0
+    if stream_tids:
+        if streaming_config is not None:
+            depth = max(1, int(streaming_config.depth))
+            buckets = max(2, int(streaming_config.buckets))
+        else:
+            from ..utils import envvars
+
+            depth = max(1, envvars.get_int("DETPU_ADMIT_SKETCH_DEPTH"))
+            buckets = max(2, envvars.get_int("DETPU_ADMIT_SKETCH_WIDTH"))
+        for w in sorted({int(strategy.global_configs[t]["output_dim"])
+                         for t in stream_tids}):
+            rows = geom.phys_cap[w] * _pack_factor(w)
+            stream_bytes += 2 * rows * 4 + depth * buckets * 4
+
     spec = CHIP_SPECS[chip]
     per_rank = []
     for r in range(world):
-        total = alloc_rank + opt_rank + a2a_buf
+        total = alloc_rank + opt_rank + a2a_buf + stream_bytes
         per_rank.append(RankBudget(
             rank=r, tables=tables_rank[r],
             live_param_bytes=live_rank[r],
@@ -659,7 +700,8 @@ def audit_plan(target,
             opt_state_bytes=opt_rank,
             a2a_buffer_bytes=a2a_buf,
             total_bytes=total,
-            hbm_frac=total / spec.hbm_bytes))
+            hbm_frac=total / spec.hbm_bytes,
+            streaming_state_bytes=stream_bytes))
 
     slabs = []
     for w in geom.widths:
@@ -702,7 +744,8 @@ def audit_plan(target,
         grad_a2a_bytes_per_step=int(out_a2a),
         total_a2a_bytes_per_step=int(id_a2a + 2 * out_a2a),
         imbalance_ratio=float(imbalance),
-        out_pad_frac=float(pad_frac))
+        out_pad_frac=float(pad_frac),
+        n_streaming_tables=len(stream_tids))
     if contract is not None:
         check_contract(report, contract, strategy=strategy)
     return report

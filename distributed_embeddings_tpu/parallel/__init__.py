@@ -35,6 +35,10 @@ from .resilient import (
     resume_sentinel_path,
     run_resilient,
 )
+from .streaming import (
+    StreamingConfig,
+    init_streaming,
+)
 from .trainer import (
     HybridTrainState,
     init_hybrid_state,

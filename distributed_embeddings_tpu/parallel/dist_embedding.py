@@ -266,6 +266,40 @@ class DistributedEmbedding:
             for tid in rank_ids:
                 self._slices_per_table[tid] += 1
 
+        # streaming (dynamic-vocab) tables: {tid: (capacity, buckets)}.
+        # The declared input_dim IS the physical slab footprint
+        # (capacity slots + shared bucket rows), so every capacity/
+        # checkpoint/re-shard subsystem prices and moves the table like
+        # any static one; only the id INTERPRETATION changes (external
+        # ids remap through the jit-carried slot map, parallel/
+        # streaming.py). Sliced streaming tables are rejected — a slot
+        # map cannot span slices.
+        self.streaming_tables: Dict[int, tuple] = {}
+        for tid, cfg in enumerate(self.strategy.global_configs):
+            sc = cfg.get("streaming")
+            if not sc:
+                continue
+            cap, nb = int(sc["capacity"]), int(sc["buckets"])
+            if cap <= 0 or nb <= 0:
+                raise ValueError(
+                    f"table {tid}: streaming capacity/buckets must be "
+                    f"positive, got {sc!r}")
+            if cap + nb != int(cfg["input_dim"]):
+                raise ValueError(
+                    f"table {tid}: streaming capacity {cap} + buckets "
+                    f"{nb} must equal input_dim {cfg['input_dim']} (the "
+                    "slab holds the slots followed by the shared bucket "
+                    "rows)")
+            if self._slices_per_table[tid] != 1:
+                raise NotImplementedError(
+                    f"table {tid} is row/column-sliced "
+                    f"({self._slices_per_table[tid]} slices): streaming "
+                    "tables must stay unsliced (the slot map cannot span "
+                    "slices) — raise the slice thresholds or shrink the "
+                    "capacity")
+            self.streaming_tables[tid] = (cap, nb)
+        self._streaming_arrays_cache: Dict[int, list] = {}
+
         # Width-grouped stacked-table layout: per rank, tables of equal width
         # stack row-major into one 2-D slab; slab row capacity is the max over
         # ranks so the params pytree is SPMD-uniform. Narrow widths store
@@ -575,7 +609,13 @@ class DistributedEmbedding:
                             f"{nnz - cap} id(s) would be silently "
                             "truncated (ragged_overflow_raise)")
                 ids = ids.reshape(-1)[:min(nnz, cap)]
-            bad = int(((ids < 0) | (ids >= vocab)).sum())
+            if tid in self.streaming_tables:
+                # streaming tables accept the UNBOUNDED external id
+                # space by design (the slot map hashes them in-range);
+                # only negatives are invalid
+                bad = int((ids < 0).sum())
+            else:
+                bad = int(((ids < 0) | (ids >= vocab)).sum())
             if bad:
                 total += bad
                 if self.invalid_id_policy == "raise":
@@ -869,7 +909,8 @@ class DistributedEmbedding:
         """
         return self.forward_with_residuals(params, inputs)[0]
 
-    def forward_with_residuals(self, params: EmbedParams, inputs):
+    def forward_with_residuals(self, params: EmbedParams, inputs,
+                               streaming=None):
         """Forward pass that also returns the routing residuals needed by
         :meth:`sparse_apply_gradients` (the manual sparse backward).
 
@@ -877,6 +918,19 @@ class DistributedEmbedding:
         backward never re-runs the id all-to-all — mirroring how the reference
         backward reuses the forward op's inputs
         (``embedding_lookup_ops.py:116-122``).
+
+        ``streaming``: dynamic-vocab mode (:mod:`.streaming`) —
+        ``(config, state)`` remaps every streaming-table slot's external
+        ids through this device's jit-carried slot map right after the
+        id exchange (slot-map hits read their admitted slot, everything
+        else reads its shared hash bucket) and STAGES this step's
+        admission/eviction transitions; the return grows a third
+        element, the per-width ``pending`` dict the trainer hands to
+        :func:`.streaming.commit` next to the nan-guard.
+        ``(config, state, False)`` is the read-only form (eval): remap
+        only, no transitions, 2-tuple return. The residuals carry the
+        REMAPPED block, so the sparse backward, step metrics, and
+        telemetry all operate on in-range internal rows.
         """
         params = self.local_view(params)
 
@@ -899,6 +953,8 @@ class DistributedEmbedding:
                           else entries[0].dtype)
             plan = self._get_plan(encs, b)
             ids_recv = self._build_send_blocks(plan, entries, comm_dtype)
+            ids_recv, spending = self._streaming_remap(plan, ids_recv,
+                                                       streaming)
             # slot-major group outputs: per-instance outputs are plain
             # slices, skipping the exchange-row transpose the single
             # worker never needs (only multi-slot instances pay a small
@@ -930,7 +986,9 @@ class DistributedEmbedding:
                         o = o.reshape((b,) + tuple(lead) + (g.width,))
                 outs.append(o)
             result = [outs[i] for i in self.strategy.rev_global_input_ids]
-            return result, ("dist", ids_recv, tuple(encs), b)
+            res = ("dist", ids_recv, tuple(encs), b)
+            return ((result, res, spending) if spending is not None
+                    else (result, res))
 
         world = self.world_size
         if self.dp_input:
@@ -983,6 +1041,9 @@ class DistributedEmbedding:
             if not jnp.issubdtype(ids_recv.dtype, jnp.integer):
                 ids_recv = ids_recv.astype(jnp.int32)
 
+        # --- streaming remap (dynamic-vocab tables) ------------------------
+        ids_recv, spending = self._streaming_remap(plan, ids_recv, streaming)
+
         # --- rank-uniform local lookup (plan-tensor-driven) ----------------
         mp_out = self._plan_lookup(plan, params, ids_recv)  # [world, b, s_max]
 
@@ -1017,7 +1078,9 @@ class DistributedEmbedding:
                 for part in result[start + 1:end]:
                     total = total + part
                 result[start:end] = [total]
-        return result, ("dist", ids_recv, tuple(encs), b)
+        res = ("dist", ids_recv, tuple(encs), b)
+        return ((result, res, spending) if spending is not None
+                else (result, res))
 
     # ------------------------------------------------- plan-driven executor
 
@@ -1713,6 +1776,154 @@ class DistributedEmbedding:
         new["steps"] = tstate["steps"] + 1
         new["ids_total"] = tstate["ids_total"] + total
         return new
+
+    # -------------------------------------------------- streaming vocab
+
+    def _streaming_plan_arrays(self, plan) -> list:
+        """Per-group ``[world, n]`` plan tensors of the streaming remap
+        (``parallel/streaming.py``): per slot, whether its table is
+        dynamic, the slot capacity, the shared-bucket count, and the
+        (plan-invariant hash salt) global table id. Baked once per plan
+        like every other plan tensor — plans are cached for the process
+        lifetime, so ``id(plan)`` is a stable cache key."""
+        key = id(plan)
+        cached = self._streaming_arrays_cache.get(key)
+        if cached is not None:
+            return cached
+        world = self.world_size
+        out = [(np.zeros((world, g.n), np.int32),
+                np.ones((world, g.n), np.int32),
+                np.ones((world, g.n), np.int32),
+                np.zeros((world, g.n), np.int32))
+               for g in plan.groups]
+        for inst in plan.instances:
+            tid = self.strategy.input_table_map[inst.input_id]
+            info = self.streaming_tables.get(tid)
+            if info is None:
+                continue
+            dyn_a, cap_a, nb_a, tid_a = out[inst.group]
+            sl = slice(inst.slot0, inst.slot0 + inst.num_slots)
+            dyn_a[inst.rank, sl] = 1
+            cap_a[inst.rank, sl] = info[0]
+            nb_a[inst.rank, sl] = info[1]
+            tid_a[inst.rank, sl] = tid
+        self._streaming_arrays_cache[key] = out
+        return out
+
+    def _streaming_remap(self, plan, ids_recv, streaming):
+        """Remap every streaming-table slot's external ids in the
+        received block through the jit-carried slot map
+        (:func:`.streaming.remap_width`) and, in update mode, stage the
+        admission/eviction transitions.
+
+        ``streaming`` is ``None`` (no-op), ``(config, state)`` (train:
+        remap + stage), or ``(config, state, False)`` (read-only remap —
+        the eval path admits nothing). Returns ``(ids_recv, pending)``
+        with ``pending`` a ``{width: (new_wstate, scrub_rows, stats)}``
+        dict in update mode, else ``None``. Pure jax on tensors the step
+        already holds; static shapes throughout (0 steady-state
+        recompiles); only the modified group regions are rewritten
+        (static-offset ``dynamic_update_slice``)."""
+        if streaming is None:
+            return ids_recv, None
+        from . import streaming as streaming_mod
+
+        if not self.streaming_tables:
+            raise ValueError(
+                "streaming= passed but no table declares a 'streaming' "
+                "config entry")
+        if len(streaming) == 2:
+            config, sstate = streaming
+            update = True
+        else:
+            config, sstate, update = streaming
+        arrays = self._streaming_plan_arrays(plan)
+        world = self.world_size
+        my = self._my_rank()
+        b = plan.b
+        per_width: Dict[int, list] = {}
+        sites = []  # (gi, width, start-within-width-stream, original vals,
+        #             write-back mask, region tail or None)
+        for gi, g in enumerate(plan.groups):
+            dyn_a, cap_a, nb_a, tid_a = arrays[gi]
+            if not dyn_a.any():
+                continue
+            with obs.scope(f"streaming_remap_w{g.width}_{g.kind}"):
+                region = lax.slice(ids_recv, (0, g.goff),
+                                   (world, g.goff + g.n * g.blen))
+                dyn = self._plan_row(dyn_a, my)
+                cap = self._plan_row(cap_a, my)
+                nb = self._plan_row(nb_a, my)
+                tid = self._plan_row(tid_a, my)
+                roff = self._plan_row(plan.roff[gi], my)
+                if g.kind == "d":
+                    vals = region.reshape(world, g.n, b, g.hot)
+                    bshape = vals.shape
+                    dynm = jnp.broadcast_to(
+                        dyn[None, :, None, None] > 0, bshape)
+                    ex = (cap[None, :, None, None],
+                          nb[None, :, None, None],
+                          tid[None, :, None, None],
+                          roff[None, :, None, None])
+                    tail = None
+                else:
+                    r3 = region.reshape(world, g.n, g.blen)
+                    vals = r3[:, :, :g.hot]
+                    lengths = r3[:, :, g.hot:g.hot + b]
+                    tot = jnp.sum(lengths, axis=2, dtype=jnp.int32)
+                    pos_live = (
+                        jnp.arange(g.hot, dtype=jnp.int32)[None, None, :]
+                        < jnp.minimum(tot, g.hot)[:, :, None])
+                    bshape = vals.shape
+                    dynm = pos_live & (dyn[None, :, None] > 0)
+                    ex = (cap[None, :, None], nb[None, :, None],
+                          tid[None, :, None], roff[None, :, None])
+                    tail = r3[:, :, g.hot:]
+                capb, nbb, tidb, roffb = (
+                    jnp.broadcast_to(x, bshape) for x in ex)
+                acc = per_width.setdefault(g.width, [])
+                start = sum(p[0].size for p in acc)
+                acc.append((vals.reshape(-1), dynm.reshape(-1),
+                            capb.reshape(-1), nbb.reshape(-1),
+                            tidb.reshape(-1), roffb.reshape(-1)))
+                sites.append((gi, g.width, start, vals, dynm, tail))
+
+        remapped: Dict[int, jax.Array] = {}
+        pending: Dict[int, tuple] = {}
+        for w in sorted(per_width):
+            pieces = per_width[w]
+            stream = streaming_mod.WidthStream(
+                ext=jnp.concatenate([p[0] for p in pieces]),
+                live=jnp.concatenate([p[1] for p in pieces]),
+                cap=jnp.concatenate([p[2] for p in pieces]),
+                nbuckets=jnp.concatenate([p[3] for p in pieces]),
+                tid=jnp.concatenate([p[4] for p in pieces]),
+                roff=jnp.concatenate([p[5] for p in pieces]))
+            with obs.scope(f"streaming_admit_w{w}"):
+                local_rows, pend = streaming_mod.remap_width(
+                    sstate[_wkey(w)], stream, self.rows_cap[w], config,
+                    update=update)
+            remapped[w] = local_rows
+            if pend is not None:
+                pending[w] = pend
+
+        for gi, w, start, vals, dynm, tail in sites:
+            g = plan.groups[gi]
+            new = lax.slice(remapped[w], (start,),
+                            (start + vals.size,)).reshape(vals.shape)
+            # write-back keeps non-streaming slots (which may share the
+            # group), dead positions, and negative ids byte-identical —
+            # the remap never widens/narrows the block dtype
+            new_vals = jnp.where(dynm & (vals >= 0),
+                                 new.astype(vals.dtype), vals)
+            if tail is None:
+                region_new = new_vals.reshape(world, g.n * g.blen)
+            else:
+                region_new = jnp.concatenate(
+                    [new_vals, tail], axis=2).reshape(world, g.n * g.blen)
+            ids_recv = lax.dynamic_update_slice(ids_recv, region_new,
+                                                (0, g.goff))
+        return ids_recv, (pending if update else None)
 
     # ------------------------------------------------------------- checkpoint
 

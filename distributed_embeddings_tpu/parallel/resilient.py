@@ -81,7 +81,8 @@ import numpy as np
 import uuid
 
 from ..utils import envvars, obs, runtime
-from ..utils.checkpoint import (meta_run_id, previous_checkpoint_path,
+from ..utils.checkpoint import (load_aux_state, meta_run_id,
+                                previous_checkpoint_path,
                                 restore_train_state, rollback_candidates,
                                 save_train_state)
 from ..utils.data import fast_forward
@@ -214,6 +215,32 @@ def _corrupt_ids(cat_inputs):
     return jax.tree.unflatten(treedef, out)
 
 
+def _oovflood_ids(cat_inputs, spos: int):
+    """``DETPU_FAULT=oovflood@<pos>`` drill: replace every integer leaf
+    of the categorical inputs with a burst of NEVER-BEFORE-SEEN ids
+    (unique per stream position, far past any sane static vocab) — the
+    non-stationary-traffic chaos a streaming-vocab run must absorb via
+    its shared hash buckets (no crash, no recompile, no hot-row
+    eviction before the admission gate passes) and a static-vocab run
+    surfaces as out-of-vocab ids through the ``invalid_id_policy``
+    machinery."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(cat_inputs)
+    base = 1_500_000_000  # capacity-ok: an id value (far past any vocab,
+    # int32-safe), not a byte size
+    out, base = [], base + (spos % 1000) * 400_000
+    for leaf in leaves:
+        if (hasattr(leaf, "dtype")
+                and np.issubdtype(np.dtype(leaf.dtype), np.integer)):
+            arr = np.array(leaf)
+            fresh = base + np.arange(arr.size, dtype=np.int64)
+            base += arr.size
+            leaf = fresh.reshape(arr.shape).astype(arr.dtype)
+        out.append(leaf)
+    return jax.tree.unflatten(treedef, out)
+
+
 @dataclasses.dataclass
 class ResilientResult:
     """Outcome of one :func:`run_resilient` invocation."""
@@ -228,6 +255,7 @@ class ResilientResult:
     stop_reason: str           #: exhausted | preempted | on_step | until_step
     elapsed_s: float           #: wall-clock of the training loop
     telemetry: Any = None      #: final jit-carried telemetry state (if any)
+    streaming: Any = None      #: final jit-carried streaming-vocab state
     rollbacks: int = 0         #: rollback-and-replay recoveries (ledger)
     quarantined: Tuple[int, ...] = ()  #: quarantined stream positions
     rollback_time_s: float = 0.0  #: wall-clock spent restoring rollbacks
@@ -297,7 +325,8 @@ def run_resilient(step_fn: Callable, state, data, *,
                   save_on_exit: bool = True,
                   is_chief: Optional[bool] = None,
                   telemetry_state=None,
-                  telemetry_path: Optional[str] = None) -> ResilientResult:
+                  telemetry_path: Optional[str] = None,
+                  streaming_state=None) -> ResilientResult:
     """Drive ``step_fn`` over ``data`` with checkpointing, preemption
     handling, auto-resume, and poisoned-batch escalation.
 
@@ -398,6 +427,20 @@ def run_resilient(step_fn: Callable, state, data, *,
         ``<checkpoint_dir>.telemetry.json`` (atomic tmp+rename, chief
         only). With neither a path nor a checkpoint dir, telemetry is
         threaded but never flushed.
+      streaming_state: jit-carried streaming-vocab state
+        (:func:`~.streaming.init_streaming`) for a ``step_fn`` built
+        with ``dynamic=`` on. Threaded like the telemetry state (one
+        more trailing step argument/return, AFTER telemetry when both
+        ride) and — because the slot map is part of the recoverable
+        trajectory, not an auxiliary report — persisted INSIDE every
+        checkpoint (``aux/streaming.npz``, CRC-manifested, via the
+        plan-agnostic :func:`~.streaming.encode_state`): auto-resume
+        decodes it from the restored checkpoint, and the
+        rollback-and-replay recovery rewinds it from EXACTLY the ring
+        candidate it restores — the generalized aux-rewind that keeps an
+        interrupted+resumed (or rolled-back) streaming run
+        checkpoint-CRC-identical to an uninterrupted one. The final
+        state rides back on ``ResilientResult.streaming``.
 
     Returns:
       :class:`ResilientResult`. Never returns on preemption when
@@ -473,9 +516,11 @@ def run_resilient(step_fn: Callable, state, data, *,
                 "run_resilient(resume=True) with an existing checkpoint "
                 "needs emb_optimizer= and dense_tx= to rebuild the state")
         runtime.fault_point("driver.resume")
-        # events are process-global: discard any reshard recorded by an
-        # earlier unrelated restore so the drain below sees only OURS
+        # events are process-global: discard any reshard/fallback
+        # recorded by an earlier unrelated restore so the drains below
+        # see only OURS
         obs.drain_events("checkpoint_reshard")
+        obs.drain_events("checkpoint_prev_fallback")
         state = restore_train_state(
             checkpoint_dir, de, emb_optimizer, state.dense_params,
             dense_tx, mesh=mesh, on_mismatch=on_mismatch)
@@ -500,6 +545,21 @@ def run_resilient(step_fn: Callable, state, data, *,
             from ..analysis import telemetry as tel
             telemetry_state = tel.restore_telemetry_state(
                 telemetry_path + ".state.npz", telemetry_state)
+        if streaming_state is not None:
+            # the slot map rides INSIDE the checkpoint (aux/streaming.npz)
+            # — decode under the (possibly re-sharded) current plan; a
+            # pre-streaming checkpoint decodes to a pristine warm-up
+            # state. Load from the generation the PARAMS actually came
+            # from: when restore fell back to <dir>.prev (torn head),
+            # the head's newer slot map must not splice onto the older
+            # tables
+            from . import streaming as streaming_mod
+            aux_dir = checkpoint_dir
+            for ev in obs.drain_events("checkpoint_prev_fallback"):
+                aux_dir = ev.get("prev", aux_dir)
+            streaming_state = streaming_mod.decode_state(
+                de, streaming_state,
+                load_aux_state(aux_dir, "streaming"))
 
     start_step = int(state.step)
 
@@ -529,8 +589,17 @@ def run_resilient(step_fn: Callable, state, data, *,
     def _save():
         nonlocal saves, last_save_t
         runtime.fault_point("driver.save")
+        aux = None
+        if streaming_state is not None:
+            # the slot map is trajectory, not telemetry: it rides INSIDE
+            # the checkpoint (CRC-manifested, one snapshot per ring
+            # generation) in the plan-agnostic per-table encoding
+            from . import streaming as streaming_mod
+            aux = {"streaming": streaming_mod.encode_state(
+                de, streaming_state)}
         save_train_state(checkpoint_dir, de, state, is_chief=is_chief,
-                         keep_last_n=keep_last_n, run_id=run_id)
+                         keep_last_n=keep_last_n, run_id=run_id,
+                         aux_states=aux)
         _flush_telemetry()
         saves += 1
         last_save_t = time.monotonic()
@@ -669,18 +738,23 @@ def run_resilient(step_fn: Callable, state, data, *,
                     batch = _poison_batch(batch)
                 if spos in runtime.badbatch_steps():
                     cat_inputs = _corrupt_ids(cat_inputs)
+                if spos in runtime.oovflood_steps():
+                    cat_inputs = _oovflood_ids(cat_inputs, spos)
                 if check_ids:
                     de.check_inputs(cat_inputs)
 
-                if telemetry_state is not None:
-                    # telemetry-threaded steps return the carried state
-                    # LAST
-                    out = step_fn(state, cat_inputs, batch,
-                                  telemetry_state)
-                    telemetry_state = out[-1]
-                    out = out[:-1]
-                else:
-                    out = step_fn(state, cat_inputs, batch)
+                # aux-threaded steps return the carried states LAST, in
+                # the fixed (telemetry, streaming) order
+                aux_in = [a for a in (telemetry_state, streaming_state)
+                          if a is not None]
+                out = step_fn(state, cat_inputs, batch, *aux_in)
+                if aux_in:
+                    aux_out = list(out[-len(aux_in):])
+                    out = out[:-len(aux_in)]
+                    if telemetry_state is not None:
+                        telemetry_state = aux_out.pop(0)
+                    if streaming_state is not None:
+                        streaming_state = aux_out.pop(0)
                 loss, state = out[0], out[1]
                 metrics = out[2] if len(out) > 2 else None
                 steps_run += 1
@@ -812,20 +886,35 @@ def run_resilient(step_fn: Callable, state, data, *,
                             bad_window[0], bad_window[-1],
                             ledger.rollbacks, rollback_max)
                         state = new_state
+                        # ---- generalized aux rewind: EVERY jit-carried
+                        # aux state rewinds with the params — a rollback
+                        # that restored step-k tables but kept step-k+n
+                        # slot maps / sketches would splice two
+                        # trajectories (the "telemetry rewinds but other
+                        # aux state is silently kept" bug)
                         if telemetry_state is not None \
                                 and telemetry_path is not None \
                                 and os.path.isfile(
                                     _telemetry_state_path()):
-                            # rewind the carried telemetry to the last
-                            # flushed accumulation too, or the replayed
-                            # window double-counts into the hot-row
-                            # sketches (approximate — ids folded since
-                            # the last flush, incl. a later-quarantined
+                            # telemetry rewinds to its last flushed
+                            # accumulation (approximate — ids folded
+                            # since the flush, incl. a later-quarantined
                             # batch's, may remain counted; sketches are
                             # monotone estimates by design)
                             from ..analysis import telemetry as tel
                             telemetry_state = tel.restore_telemetry_state(
                                 _telemetry_state_path(), telemetry_state)
+                        if streaming_state is not None:
+                            # streaming state rewinds EXACTLY: each ring
+                            # generation carries its own aux snapshot,
+                            # so the slot map restores from the SAME
+                            # candidate the params did (a pre-streaming
+                            # candidate decodes to a pristine warm-up
+                            # map — degraded to buckets, never spliced)
+                            from . import streaming as streaming_mod
+                            streaming_state = streaming_mod.decode_state(
+                                de, streaming_state,
+                                load_aux_state(how, "streaming"))
                         consecutive = 0
                         bad_window = []
                         restart = True
@@ -897,7 +986,8 @@ def run_resilient(step_fn: Callable, state, data, *,
         preempted=preempted, skipped_steps=skipped,
         checkpoints_saved=saves, last_loss=last_loss,
         stop_reason=stop_reason, elapsed_s=elapsed,
-        telemetry=telemetry_state, rollbacks=ledger.rollbacks,
+        telemetry=telemetry_state, streaming=streaming_state,
+        rollbacks=ledger.rollbacks,
         quarantined=tuple(sorted(ledger.quarantined)),
         rollback_time_s=round(rollback_time, 4))
     if preempted and exit_on_preempt and checkpoint_dir is not None:

@@ -29,10 +29,12 @@ from .dist_embedding import DistributedEmbedding
 from .grads import resolve_dp_gradient
 
 
-def _metric_specs(axis_name: str):
+def _metric_specs(axis_name: str, extra=()):
     """shard_map out_specs for the step-metrics dict: every ``[1]``
-    per-device entry concatenates into a ``[world]`` per-rank vector."""
-    return {k: P(axis_name) for k in obs.STEP_METRIC_KEYS}
+    per-device entry concatenates into a ``[world]`` per-rank vector.
+    ``extra`` appends conditional key sets (the ``stream_*`` metrics of
+    dynamic-table steps)."""
+    return {k: P(axis_name) for k in obs.STEP_METRIC_KEYS + tuple(extra)}
 
 
 def _sq_sum(tree) -> jax.Array:
@@ -86,7 +88,8 @@ def _table_sentinels(de, out_grads, lr):
 
 def _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer, lr_schedule,
                        state, cat_inputs, batch, with_metrics=False,
-                       nan_guard=False, telemetry_cfg=None, telem=None):
+                       nan_guard=False, telemetry_cfg=None, telem=None,
+                       streaming_cfg=None, sstate=None):
     """One per-device hybrid step (shared by :func:`make_hybrid_train_step`
     and :func:`make_hybrid_train_loop`): forward, one backward producing dp
     gradients (pmean-averaged) and mp cotangents (manual sparse path), both
@@ -115,13 +118,28 @@ def _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer, lr_schedule,
     LAST element. Telemetry reads the same residual tensors the metrics
     do and touches nothing in the parameter/optimizer path — with it off
     the step is bit-for-bit the pre-telemetry program.
+
+    ``streaming_cfg`` (static) + ``sstate`` (this device's jit-carried
+    streaming-vocab state, :mod:`.streaming`): when given, the forward
+    remaps every streaming table's external ids through the slot map
+    (admitted ids read their slot, everything else its shared hash
+    bucket) and STAGES the admission/eviction transitions; they COMMIT
+    next to the nan-guard — a guard-skipped step leaves the slot map,
+    sketch, and slabs bitwise-unchanged, exactly like the optimizer
+    state, so the rollback/quarantine machinery sees one coherent
+    trajectory. The updated streaming state returns as the step's LAST
+    element (after the telemetry state when both ride).
     """
     world = de.world_size
     # slabs are {width: [world, rows, w]} globally -> [rows, w] per device
     emb_local = de.local_view(state.emb_params)
     emb_opt_local = de.local_view(state.emb_opt_state)
     with obs.scope("embedding_forward"):
-        outs, res = de.forward_with_residuals(emb_local, cat_inputs)
+        if streaming_cfg is not None:
+            outs, res, spending = de.forward_with_residuals(
+                emb_local, cat_inputs, streaming=(streaming_cfg, sstate))
+        else:
+            outs, res = de.forward_with_residuals(emb_local, cat_inputs)
     new_telem = None
     if telemetry_cfg is not None:
         with obs.scope("telemetry"):
@@ -157,6 +175,19 @@ def _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer, lr_schedule,
             emb_local, emb_opt_local, res, out_grads, emb_optimizer, lr,
             enable=ok)
 
+    new_sstate = None
+    sstats = None
+    if streaming_cfg is not None:
+        from . import streaming as streaming_mod
+
+        # commit AFTER the optimizer scatter and UNDER the guard verdict:
+        # claimed rows zero post-apply (the evictee's last update is
+        # dropped with its slot), and a skipped step leaves slot map,
+        # sketch, counters and slabs bitwise-unchanged
+        with obs.scope("streaming_commit"):
+            new_emb, new_sstate, sstats = streaming_mod.commit(
+                de, new_emb, spending, sstate, enable=ok)
+
     with obs.scope("dense_update"):
         updates, dense_opt_state = dense_tx.update(
             dense_grads, state.dense_opt_state, state.dense_params)
@@ -184,9 +215,13 @@ def _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer, lr_schedule,
         emb_opt_state=de.stacked_view(new_emb_opt),
         dense_params=dense_params, dense_opt_state=dense_opt_state,
         step=state.step + 1)
+    aux_out = ()
+    if new_telem is not None:
+        aux_out += (new_telem,)
+    if new_sstate is not None:
+        aux_out += (new_sstate,)
     if not with_metrics:
-        return ((loss, new_state, new_telem) if new_telem is not None
-                else (loss, new_state))
+        return (loss, new_state) + aux_out
     metrics = de.step_metrics(
         res, out_dtype=out_grads[0].dtype if out_grads else None)
     with obs.scope("health_sentinels"):
@@ -204,9 +239,13 @@ def _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer, lr_schedule,
                else jnp.zeros((1,), jnp.int32))
     metrics["skipped_steps"] = de._vary(skipped)
     metrics["step"] = de._vary(state.step.astype(jnp.int32).reshape(1))
-    if new_telem is not None:
-        return loss, new_state, metrics, new_telem
-    return loss, new_state, metrics
+    if sstats is not None:
+        # this step's (guard-gated) slot-map transition counts — derived
+        # from the device-varying routed ids, so P(axis) stacks them per
+        # rank like every other metric
+        for k, v in sstats.items():
+            metrics[f"stream_{k}"] = v
+    return (loss, new_state, metrics) + aux_out
 
 
 class HybridTrainState(NamedTuple):
@@ -221,6 +260,26 @@ class HybridTrainState(NamedTuple):
     step: jax.Array
 
 
+def _with_aux_signature(core, tel_on: bool, dyn_on: bool):
+    """Give ``core(state, cat, batch, aux_tuple)`` the explicit
+    positional signature its aux combination implies — jit donation and
+    shard_map specs then address plain positional args (aux order:
+    telemetry, then streaming)."""
+    if tel_on and dyn_on:
+        def step(state, cat_inputs, batch, telem, stream):
+            return core(state, cat_inputs, batch, (telem, stream))
+    elif tel_on:
+        def step(state, cat_inputs, batch, telem):
+            return core(state, cat_inputs, batch, (telem,))
+    elif dyn_on:
+        def step(state, cat_inputs, batch, stream):
+            return core(state, cat_inputs, batch, (stream,))
+    else:
+        def step(state, cat_inputs, batch):
+            return core(state, cat_inputs, batch, ())
+    return step
+
+
 def make_hybrid_train_step(de: DistributedEmbedding,
                            loss_fn: Callable,
                            dense_tx: optax.GradientTransformation,
@@ -229,7 +288,8 @@ def make_hybrid_train_step(de: DistributedEmbedding,
                            lr_schedule=1.0,
                            with_metrics: Optional[bool] = None,
                            nan_guard: Optional[bool] = None,
-                           telemetry=None):
+                           telemetry=None,
+                           dynamic=None):
     """Build ``step(state, cat_inputs, batch) -> (loss, state)``.
 
     Args:
@@ -275,11 +335,22 @@ def make_hybrid_train_step(de: DistributedEmbedding,
         untouched: telemetry-off steps are bit-for-bit the pre-telemetry
         program, telemetry-on steps change only the extra output.
 
+    ``dynamic`` opts the step into streaming-vocab mode
+    (:mod:`.streaming`) with the same explicit-opt-in contract as
+    ``telemetry`` (``None``/``False`` off, ``True`` env policy, a
+    :class:`~.streaming.StreamingConfig` pins it): the step takes the
+    jit-carried streaming state (:func:`~.streaming.init_streaming`,
+    donated) as one more trailing argument — AFTER the telemetry state
+    when both ride — and returns the updated state last. Under
+    ``with_metrics`` the :data:`~..utils.obs.STREAMING_METRIC_KEYS`
+    entries join the metrics dict.
+
     The returned step takes data-parallel shards: each categorical input
     ``[local_batch, hotness]`` and ``batch`` any pytree of per-device arrays
     the loss consumes (already sharded by the caller).
     """
     from ..analysis import telemetry as tel
+    from . import streaming as streaming_mod
 
     world = de.world_size
     if with_metrics is None:
@@ -287,24 +358,36 @@ def make_hybrid_train_step(de: DistributedEmbedding,
     if nan_guard is None:
         nan_guard = obs.nanguard_enabled()
     tel_cfg = tel.resolve_config(telemetry)
+    dyn_cfg = streaming_mod.resolve_config(dynamic)
+    n_aux = (tel_cfg is not None) + (dyn_cfg is not None)
 
-    if tel_cfg is None:
-        def local_step(state: HybridTrainState, cat_inputs, batch):
-            return _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer,
-                                      lr_schedule, state, cat_inputs, batch,
-                                      with_metrics=with_metrics,
-                                      nan_guard=nan_guard)
-    else:
-        def local_step(state: HybridTrainState, cat_inputs, batch, telem):
-            out = _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer,
-                                     lr_schedule, state, cat_inputs, batch,
-                                     with_metrics=with_metrics,
-                                     nan_guard=nan_guard,
-                                     telemetry_cfg=tel_cfg,
-                                     telem=tel.local_state(telem))
-            return out[:-1] + (tel.stacked_state(out[-1]),)
+    def core(state: HybridTrainState, cat_inputs, batch, aux):
+        i = 0
+        telem = sstate = None
+        if tel_cfg is not None:
+            telem = tel.local_state(aux[i])
+            i += 1
+        if dyn_cfg is not None:
+            sstate = streaming_mod.local_state(aux[i])
+        out = _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer,
+                                 lr_schedule, state, cat_inputs, batch,
+                                 with_metrics=with_metrics,
+                                 nan_guard=nan_guard,
+                                 telemetry_cfg=tel_cfg, telem=telem,
+                                 streaming_cfg=dyn_cfg, sstate=sstate)
+        if not n_aux:
+            return out
+        head, aux_out = out[:-n_aux], list(out[-n_aux:])
+        stacked = []
+        if tel_cfg is not None:
+            stacked.append(tel.stacked_state(aux_out.pop(0)))
+        if dyn_cfg is not None:
+            stacked.append(streaming_mod.stacked_state(aux_out.pop(0)))
+        return head + tuple(stacked)
 
-    donate = (0,) if tel_cfg is None else (0, 3)
+    local_step = _with_aux_signature(core, tel_cfg is not None,
+                                     dyn_cfg is not None)
+    donate = (0,) + tuple(range(3, 3 + n_aux))
     if world == 1:
         return jax.jit(local_step, donate_argnums=donate)
 
@@ -314,12 +397,12 @@ def make_hybrid_train_step(de: DistributedEmbedding,
     state_specs = HybridTrainState(
         emb_params=P(ax), emb_opt_state=P(ax),
         dense_params=P(), dense_opt_state=P(), step=P())
-    out_specs = ((P(), state_specs, _metric_specs(ax)) if with_metrics
+    mspecs = _metric_specs(
+        ax, obs.STREAMING_METRIC_KEYS if dyn_cfg is not None else ())
+    out_specs = ((P(), state_specs, mspecs) if with_metrics
                  else (P(), state_specs))
-    in_specs = (state_specs, P(ax), P(ax))
-    if tel_cfg is not None:
-        out_specs = out_specs + (P(ax),)
-        in_specs = in_specs + (P(ax),)
+    in_specs = (state_specs, P(ax), P(ax)) + (P(ax),) * n_aux
+    out_specs = out_specs + (P(ax),) * n_aux
 
     sm = jax.shard_map(
         local_step, mesh=mesh,
@@ -337,7 +420,8 @@ def make_hybrid_train_loop(de: DistributedEmbedding,
                            unroll: int = 1,
                            with_metrics: Optional[bool] = None,
                            nan_guard: Optional[bool] = None,
-                           telemetry=None):
+                           telemetry=None,
+                           dynamic=None):
     """Multi-step training driver: ``loop(state, cat_stacks, batch_stacks)
     -> (losses [K], state)`` running K steps inside ONE compiled program via
     ``lax.scan``.
@@ -366,8 +450,15 @@ def make_hybrid_train_loop(de: DistributedEmbedding,
     cat_stacks, batch_stacks, telem) -> (losses, state[, metrics],
     telem)`` — every scanned step folds its ids in, ONE carried state
     for the whole dispatch.
+
+    ``dynamic`` (explicit opt-in, same contract as the single step's)
+    threads the streaming-vocab state through the scan carry the same
+    way — slot-map admissions/evictions accumulate across the scanned
+    steps inside one compiled program; the state rides AFTER the
+    telemetry state when both are on.
     """
     from ..analysis import telemetry as tel
+    from . import streaming as streaming_mod
 
     world = de.world_size
     if with_metrics is None:
@@ -375,24 +466,34 @@ def make_hybrid_train_loop(de: DistributedEmbedding,
     if nan_guard is None:
         nan_guard = obs.nanguard_enabled()
     tel_cfg = tel.resolve_config(telemetry)
+    dyn_cfg = streaming_mod.resolve_config(dynamic)
+    n_aux = (tel_cfg is not None) + (dyn_cfg is not None)
 
     def body(carry, xs):
         cat_inputs, batch = xs
-        state, telem = carry if tel_cfg is not None else (carry, None)
+        state = carry[0] if n_aux else carry
+        aux = carry[1:] if n_aux else ()
+        i = 0
+        telem = sstate = None
+        if tel_cfg is not None:
+            telem = aux[i]
+            i += 1
+        if dyn_cfg is not None:
+            sstate = aux[i]
         out = _hybrid_local_step(
             de, loss_fn, dense_tx, emb_optimizer, lr_schedule, state,
             cat_inputs, batch, with_metrics=with_metrics,
-            nan_guard=nan_guard, telemetry_cfg=tel_cfg, telem=telem)
-        if tel_cfg is not None:
-            telem = out[-1]
-            out = out[:-1]
+            nan_guard=nan_guard, telemetry_cfg=tel_cfg, telem=telem,
+            streaming_cfg=dyn_cfg, sstate=sstate)
+        new_aux = out[len(out) - n_aux:] if n_aux else ()
+        out = out[:len(out) - n_aux] if n_aux else out
         if with_metrics:
             loss, state, metrics = out
             ys = (loss, metrics)
         else:
             loss, state = out
             ys = loss
-        return ((state, telem) if tel_cfg is not None else state), ys
+        return ((state,) + tuple(new_aux) if n_aux else state), ys
 
     def run_scan(carry, cat_stacks, batch_stacks):
         # shared by world == 1 and shard_map (_hybrid_local_step already
@@ -404,25 +505,33 @@ def make_hybrid_train_loop(de: DistributedEmbedding,
             return carry, (losses, metrics)
         return carry, (ys, None)
 
-    if tel_cfg is None:
-        def local_loop(state, cat_stacks, batch_stacks):
-            state, (losses, metrics) = run_scan(state, cat_stacks,
-                                                batch_stacks)
-            if with_metrics:
-                return losses, state, metrics
-            return losses, state
-    else:
-        def local_loop(state, cat_stacks, batch_stacks, telem):
-            # local/stacked views once per dispatch, not per scanned step
-            carry = (state, tel.local_state(telem))
-            (state, telem), (losses, metrics) = run_scan(
-                carry, cat_stacks, batch_stacks)
-            telem = tel.stacked_state(telem)
-            if with_metrics:
-                return losses, state, metrics, telem
-            return losses, state, telem
+    def core(state, cat_stacks, batch_stacks, aux):
+        # local/stacked views once per dispatch, not per scanned step
+        i = 0
+        locals_ = []
+        if tel_cfg is not None:
+            locals_.append(tel.local_state(aux[i]))
+            i += 1
+        if dyn_cfg is not None:
+            locals_.append(streaming_mod.local_state(aux[i]))
+        carry = (state,) + tuple(locals_) if n_aux else state
+        carry, (losses, metrics) = run_scan(carry, cat_stacks,
+                                            batch_stacks)
+        state = carry[0] if n_aux else carry
+        stacked = []
+        if n_aux:
+            aux_out = list(carry[1:])
+            if tel_cfg is not None:
+                stacked.append(tel.stacked_state(aux_out.pop(0)))
+            if dyn_cfg is not None:
+                stacked.append(streaming_mod.stacked_state(aux_out.pop(0)))
+        head = ((losses, state, metrics) if with_metrics
+                else (losses, state))
+        return head + tuple(stacked)
 
-    donate = (0,) if tel_cfg is None else (0, 3)
+    local_loop = _with_aux_signature(core, tel_cfg is not None,
+                                     dyn_cfg is not None)
+    donate = (0,) + tuple(range(3, 3 + n_aux))
     if world == 1:
         return jax.jit(local_loop, donate_argnums=donate)
 
@@ -432,13 +541,13 @@ def make_hybrid_train_loop(de: DistributedEmbedding,
     state_specs = HybridTrainState(
         emb_params=P(ax), emb_opt_state=P(ax),
         dense_params=P(), dense_opt_state=P(), step=P())
+    loop_keys = obs.STEP_METRIC_KEYS + (
+        obs.STREAMING_METRIC_KEYS if dyn_cfg is not None else ())
     out_specs = ((P(), state_specs,
-                  {k: P(None, ax) for k in obs.STEP_METRIC_KEYS})
+                  {k: P(None, ax) for k in loop_keys})
                  if with_metrics else (P(), state_specs))
-    in_specs = (state_specs, P(None, ax), P(None, ax))
-    if tel_cfg is not None:
-        out_specs = out_specs + (P(ax),)
-        in_specs = in_specs + (P(ax),)
+    in_specs = (state_specs, P(None, ax), P(None, ax)) + (P(ax),) * n_aux
+    out_specs = out_specs + (P(ax),) * n_aux
 
     sm = jax.shard_map(
         local_loop, mesh=mesh,
@@ -449,7 +558,8 @@ def make_hybrid_train_loop(de: DistributedEmbedding,
 
 def make_hybrid_eval_step(de: DistributedEmbedding,
                           pred_fn: Callable,
-                          mesh=None):
+                          mesh=None,
+                          dynamic=None):
     """Build ``eval_step(state, cat_inputs, batch) -> global predictions``.
 
     The inference analogue of :func:`make_hybrid_train_step` — the reference
@@ -462,12 +572,32 @@ def make_hybrid_eval_step(de: DistributedEmbedding,
       pred_fn: ``pred_fn(dense_params, emb_outputs, batch) -> predictions``
         over the per-device batch shard.
       mesh: required when ``de.world_size > 1``.
+      dynamic: streaming-vocab mode (same resolution as the train step's
+        ``dynamic=``): the eval step then takes the carried streaming
+        state as a fourth argument — ``eval_step(state, cat_inputs,
+        batch, stream)`` — and serves ids through the slot map
+        READ-ONLY: admitted ids read their slots, everything else its
+        shared bucket; no admissions, no state mutation (the state is
+        not donated), so interleaved eval never perturbs the training
+        trajectory.
     """
-    world = de.world_size
+    from . import streaming as streaming_mod
 
-    def local_eval(state: HybridTrainState, cat_inputs, batch):
-        outs = de(state.emb_params, cat_inputs)
-        return pred_fn(state.dense_params, outs, batch)
+    world = de.world_size
+    dyn_cfg = streaming_mod.resolve_config(dynamic)
+
+    if dyn_cfg is None:
+        def local_eval(state: HybridTrainState, cat_inputs, batch):
+            outs = de(state.emb_params, cat_inputs)
+            return pred_fn(state.dense_params, outs, batch)
+    else:
+        def local_eval(state: HybridTrainState, cat_inputs, batch,
+                       stream):
+            outs, _ = de.forward_with_residuals(
+                state.emb_params, cat_inputs,
+                streaming=(dyn_cfg, streaming_mod.local_state(stream),
+                           False))
+            return pred_fn(state.dense_params, outs, batch)
 
     if world == 1:
         return jax.jit(local_eval)
@@ -477,9 +607,12 @@ def make_hybrid_eval_step(de: DistributedEmbedding,
     state_specs = HybridTrainState(
         emb_params=P(ax), emb_opt_state=P(ax),
         dense_params=P(), dense_opt_state=P(), step=P())
+    in_specs = (state_specs, P(ax), P(ax))
+    if dyn_cfg is not None:
+        in_specs = in_specs + (P(ax),)
     sm = jax.shard_map(
         local_eval, mesh=mesh,
-        in_specs=(state_specs, P(ax), P(ax)),
+        in_specs=in_specs,
         out_specs=P(ax))
     return jax.jit(sm)
 

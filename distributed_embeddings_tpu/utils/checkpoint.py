@@ -476,7 +476,9 @@ def save_train_state(path: str, de, state: HybridTrainState,
                      is_chief: Optional[bool] = None,
                      keep_previous: bool = True,
                      keep_last_n: int = 0,
-                     run_id: Optional[str] = None) -> None:
+                     run_id: Optional[str] = None,
+                     aux_states: Optional[Dict[str, Dict[str, Any]]]
+                     = None) -> None:
     """Write the full train state under ``path`` (a directory), atomically.
 
     Every process must call this (the streamed table fetches are
@@ -504,7 +506,19 @@ def save_train_state(path: str, de, state: HybridTrainState,
 
     ``run_id`` stamps the manifest with a run-lineage id
     (:func:`meta_run_id`) so a rollback can tell this run's generations
-    from a previous run's leftovers in the same directory."""
+    from a previous run's leftovers in the same directory.
+
+    ``aux_states`` persists named jit-carried auxiliary state INSIDE the
+    checkpoint (``aux/<name>.npz``, CRC-manifested like every other
+    file): each entry is a flat ``{key: array}`` dict in a
+    plan-AGNOSTIC encoding chosen by its producer (e.g. the
+    streaming-vocab slot maps via
+    :func:`~..parallel.streaming.encode_state`). Because every ring
+    generation carries its own aux snapshot, the rollback-and-replay
+    recovery rewinds aux state to EXACTLY the candidate it restores —
+    not to some newer sidecar — and :func:`reshard_checkpoint` moves
+    the files byte-identically (the encoding owes its plan-agnosticism
+    to the producer). Read back with :func:`load_aux_state`."""
     if is_chief is None:
         is_chief = jax.process_index() == 0
     staging = _staging_path(path)
@@ -542,6 +556,12 @@ def save_train_state(path: str, de, state: HybridTrainState,
             put(f"emb_opt/{name}.npz",
                 lambda f, c=comp: np.savez(
                     f, **{k: np.asarray(v) for k, v in c.items()}))
+        if aux_states:
+            os.makedirs(os.path.join(staging, "aux"), exist_ok=True)
+            for name, enc in sorted(aux_states.items()):
+                put(f"aux/{name}.npz",
+                    lambda f, c=enc: np.savez(
+                        f, **{k: np.asarray(v) for k, v in c.items()}))
         dense = {"dense_params": state.dense_params,
                  "dense_opt_state": state.dense_opt_state,
                  "step": state.step}
@@ -568,6 +588,10 @@ def save_train_state(path: str, de, state: HybridTrainState,
                 "plan": de.strategy.plan_spec(),
                 "slab_components": sorted(slabs),
                 "aux_components": sorted(aux),
+                # jit-carried auxiliary states riding the checkpoint
+                # (aux/<name>.npz; plan-agnostic encodings — see the
+                # aux_states docstring)
+                "aux_states": sorted(aux_states or {}),
                 # per-component saved dtypes: a bf16-tables + fp32-accumulator
                 # run must restore with the SAME mixed dtypes by default
                 # (ADVICE r4) — restore reads these unless overridden
@@ -683,6 +707,12 @@ def restore_train_state(path: str, de, emb_optimizer, dense_template,
             "checkpoint at %s failed validation (%s); falling back to the "
             "previous valid checkpoint at %s", path, e, prev)
         meta = verify_checkpoint(prev)  # must itself be whole, or we raise
+        from . import obs
+
+        # let drivers learn WHICH generation actually restored: anything
+        # restored alongside the params (the streaming aux state) must
+        # come from the SAME directory, or two trajectories splice
+        obs.record_event("checkpoint_prev_fallback", path=path, prev=prev)
         path = prev
     # structural match BEFORE any data streams: a mismatched-but-whole
     # checkpoint is a config error, not corruption — no .prev fallback
@@ -767,6 +797,24 @@ def restore_train_state(path: str, de, emb_optimizer, dense_template,
         dense_params=dense["dense_params"],
         dense_opt_state=dense["dense_opt_state"],
         step=jnp.asarray(dense["step"]))
+
+
+def load_aux_state(path: str, name: str) -> Optional[Dict[str, Any]]:
+    """Read one ``aux_states`` entry written by :func:`save_train_state`
+    back as a ``{key: numpy array}`` dict. ``None`` when the checkpoint
+    predates aux persistence or never carried ``name`` — aux state is
+    auxiliary by contract and must never block a restore (its producer
+    decodes ``None`` into a pristine warm-up state)."""
+    fp = os.path.join(path, "aux", f"{name}.npz")
+    if not os.path.isfile(fp):
+        return None
+    try:
+        with np.load(fp) as loaded:
+            return {k: loaded[k] for k in loaded.files}
+    except (OSError, ValueError, zlib.error) as e:
+        logger.warning("aux state %s at %s unreadable (%s); treating as "
+                       "absent", name, path, e)
+        return None
 
 
 # --------------------------------------------------- offline re-shard codec

@@ -141,6 +141,24 @@ declare("DETPU_PROFILE_PORT", default=None,
         doc="port for a live jax profiler server (obs.maybe_start_server); "
             "unset = no server")
 
+# streaming vocab: frequency-gated admission + approximate-LFU eviction
+# (parallel/streaming.py; carried through train steps built by
+# parallel/trainer.py with dynamic=)
+declare("DETPU_ADMIT_MIN_COUNT", default="2",
+        doc="count-min estimate an external id needs before it may claim "
+            "a dynamic-table slot; below it the id is served from its "
+            "shared hash bucket")
+declare("DETPU_ADMIT_SKETCH_DEPTH", default="4",
+        doc="admission count-min sketch rows (independent hashes) per "
+            "streaming width slab")
+declare("DETPU_ADMIT_SKETCH_WIDTH", default="4096",
+        doc="admission count-min sketch buckets per row; estimate error "
+            "~ total_ids/buckets")
+declare("DETPU_EVICT_MARGIN", default="1",
+        doc="approximate-LFU eviction margin: a claim on an occupied "
+            "slot succeeds only when the incoming estimate >= occupant "
+            "frequency + margin (0 = ties evict)")
+
 # non-finite guard (utils/obs.py + parallel/trainer.py + resilient.py)
 declare("DETPU_NANGUARD", default="1",
         doc="on-device non-finite guard in the hybrid step; 0 = build the "
@@ -189,7 +207,11 @@ declare("DETPU_FAULT", default="",
             "nan@<step> (poison one rank's loss at that batch — the NaN-"
             "storm drill the rollback-and-replay recovery quarantines), or "
             "badbatch@<step> (corrupt that input batch's categorical ids — "
-            "exercises the invalid-input policies end to end)")
+            "exercises the invalid-input policies end to end), or "
+            "oovflood@<pos> (replace that batch's categorical ids with a "
+            "burst of never-before-seen ids — the non-stationary-traffic "
+            "drill the streaming-vocab admission/bucket machinery must "
+            "absorb without recompiles or crashes)")
 declare("DETPU_ON_MISMATCH", default="reshard",
         doc="resilient-driver restore policy when a checkpoint's recorded "
             "sharding plan/world size differs from the model's: 'reshard' "

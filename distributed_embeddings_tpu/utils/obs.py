@@ -95,6 +95,18 @@ STEP_METRIC_KEYS = (
 TABLE_HEALTH_KEYS = ("table_grad_norm", "table_update_maxabs",
                      "table_nonfinite")
 
+#: Extra step-metric keys of streaming-vocab (dynamic-table) steps —
+#: present only when the step was built with ``dynamic=`` on
+#: (``parallel/streaming.py``). Per-device ``[1]`` counts of THIS step's
+#: slot-map transitions, gated by the non-finite guard like the updates
+#: they describe (a skipped step reports zeros).
+STREAMING_METRIC_KEYS = (
+    "stream_admitted",    # external ids admitted to a real slot
+    "stream_evicted",     # slot occupants evicted back to their bucket
+    "stream_bucket_ids",  # live ids served from a shared hash bucket
+    "stream_hit_ids",     # live ids served from their admitted slot
+)
+
 
 def metrics_enabled() -> bool:
     """Whether ``DETPU_OBS`` asks for step metrics (read per call so tests
@@ -384,14 +396,15 @@ def summarize(metrics: Dict[str, Any]) -> Dict[str, Any]:
     import numpy as np
 
     out: Dict[str, Any] = {}
-    for k in STEP_METRIC_KEYS:
+    for k in STEP_METRIC_KEYS + STREAMING_METRIC_KEYS:
         if k not in metrics:
             continue
         v = np.asarray(metrics[k]).reshape(-1)
         if v.size == 0:
             continue
         if k in ("ids_routed", "invalid_id_count", "id_a2a_bytes",
-                 "out_a2a_bytes", "grad_a2a_bytes"):
+                 "out_a2a_bytes", "grad_a2a_bytes"
+                 ) or k in STREAMING_METRIC_KEYS:
             out[k] = float(v.sum())
         elif k in ("id_overflow", "out_pad_frac", "emb_grad_norm",
                    "skipped_steps") or k in TABLE_HEALTH_KEYS:

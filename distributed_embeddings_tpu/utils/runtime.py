@@ -140,10 +140,11 @@ def _fault_specs() -> List[Tuple[str, str, Optional[str]]]:
         item = item.strip()
         if not item:
             continue
-        if (item.startswith(("preempt@", "nan@", "badbatch@"))
+        if (item.startswith(("preempt@", "nan@", "badbatch@", "oovflood@"))
                 or item == "corrupt@ckpt"):
             continue  # driver/checkpoint-level drills: see preempt_step(),
-            # nan_steps(), badbatch_steps() and corrupt_ckpt_requested()
+            # nan_steps(), badbatch_steps(), oovflood_steps() and
+            # corrupt_ckpt_requested()
         parts = item.split(":", 2)
         if len(parts) < 2:
             logger.warning("ignoring malformed %s entry %r", FAULT_ENV, item)
@@ -205,6 +206,21 @@ def badbatch_steps() -> Tuple[int, ...]:
     machinery (clamp / drop / raise + ``invalid_id_count``) must absorb
     or escalate."""
     return _at_steps("badbatch")
+
+
+def oovflood_steps() -> Tuple[int, ...]:
+    """Batch indices of ``DETPU_FAULT=oovflood@<pos>`` drills: at each of
+    those stream positions the resilient driver replaces the batch's
+    categorical ids with a burst of NEVER-BEFORE-SEEN ids before
+    dispatch — the non-stationary-traffic chaos drill. A streaming-vocab
+    run (``parallel/streaming.py``) must absorb the flood gracefully:
+    the novel ids land in their shared hash buckets (no crash, no
+    recompile, no hot-row eviction until the sketch gate passes); a
+    static-vocab run sees them as out-of-vocab ids the
+    ``invalid_id_policy`` machinery clamps/drops/escalates. Targets
+    STREAM positions (like ``nan@``/``badbatch@``) so rollback replays
+    re-inject deterministically."""
+    return _at_steps("oovflood")
 
 
 def corrupt_ckpt_requested() -> bool:

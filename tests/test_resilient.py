@@ -650,6 +650,41 @@ def test_nan_badbatch_fault_parsing(monkeypatch):
     assert runtime.nan_steps() == () and runtime.badbatch_steps() == ()
 
 
+def test_oovflood_fault_parsing(monkeypatch):
+    monkeypatch.setenv(runtime.FAULT_ENV, "oovflood@3,nan@5,raise:x:1")
+    assert runtime.oovflood_steps() == (3,)
+    assert runtime.nan_steps() == (5,)
+    # the @-entry must not confuse the mode:point parser
+    assert ("raise", "x", "1") in runtime._fault_specs()
+    monkeypatch.setenv(runtime.FAULT_ENV, "oovflood@2, oovflood@9 ")
+    assert runtime.oovflood_steps() == (2, 9)
+    monkeypatch.setenv(runtime.FAULT_ENV, "oovflood@nope")
+    assert runtime.oovflood_steps() == ()
+    monkeypatch.delenv(runtime.FAULT_ENV)
+    assert runtime.oovflood_steps() == ()
+
+
+def test_oovflood_injects_fresh_ids(monkeypatch):
+    """The oovflood drill swaps a batch's integer leaves for a burst of
+    never-before-seen ids — distinct within the burst, deterministic per
+    stream position, and int-dtype-preserving."""
+    from distributed_embeddings_tpu.parallel import resilient as res
+
+    cats = [np.arange(8, dtype=np.int32),
+            np.zeros((4, 2), np.int64),
+            np.ones((3,), np.float32)]  # non-integer leaf untouched
+    out = res._oovflood_ids(cats, spos=3)
+    assert out[0].dtype == np.int32 and out[1].dtype == np.int64
+    flood = np.concatenate([out[0].reshape(-1), out[1].reshape(-1)])
+    assert len(set(flood.tolist())) == flood.size  # all distinct
+    assert flood.min() >= 1_000_000_000  # far past any sane vocab
+    assert np.array_equal(out[2], cats[2])
+    out2 = res._oovflood_ids(cats, spos=3)
+    assert np.array_equal(out[0], out2[0])  # deterministic per position
+    out3 = res._oovflood_ids(cats, spos=4)
+    assert not np.array_equal(out[0], out3[0])  # fresh per position
+
+
 # ----------------------------------------------------- fast_forward / misc
 
 

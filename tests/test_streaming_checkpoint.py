@@ -1,0 +1,262 @@
+"""Dynamic-table state is part of the recoverable trajectory: checkpoint
+round-trips (bitwise), offline re-shard round-trips (8→4→8), the
+generalized aux rewind, and rollback-after-eviction CRC-identity on the
+8-virtual-device mesh.
+
+The satellite contracts under test:
+
+* ``save_train_state(aux_states=)`` persists the slot map + sketch
+  CRC-manifested inside the checkpoint; ``load_aux_state`` +
+  ``streaming.decode_state`` reproduce the carried state bitwise;
+* ``tools/reshard.py``'s codec moves the (plan-agnostic) aux file
+  byte-identically: 8→4→8 restores bitwise;
+* rollback-and-replay rewinds the slot map with the ring exactly like
+  the params (the "other jit-carried aux state is silently kept" fix):
+  a streaming run that hits a NaN storm AFTER evictions recovers to a
+  final checkpoint CRC-identical to the stream-minus-poison run.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from jax.sharding import Mesh
+
+from distributed_embeddings_tpu.parallel import (
+    DistributedEmbedding, SparseAdagrad, StreamingConfig,
+    init_hybrid_state, init_streaming, make_hybrid_train_step,
+    run_resilient)
+from distributed_embeddings_tpu.parallel import streaming as smod
+from distributed_embeddings_tpu.utils.checkpoint import (
+    load_aux_state, reshard_checkpoint, restore_train_state,
+    save_train_state, verify_checkpoint)
+
+
+SCFG = StreamingConfig(admit_min_count=2, evict_margin=1, depth=2,
+                       buckets=64)
+
+
+def _configs(n_static=7, dim=8):
+    cfgs = [{"input_dim": 24 + 3 * i, "output_dim": dim}
+            for i in range(n_static)]
+    cfgs.append({"input_dim": 64 + 8, "output_dim": dim,
+                 "streaming": {"capacity": 64, "buckets": 8}})
+    return cfgs
+
+
+def _build(world, mesh=None, seed=0):
+    cfgs = _configs()
+    de = DistributedEmbedding(cfgs, world_size=world)
+    emb_opt = SparseAdagrad()
+    tx = optax.sgd(0.1)
+    state = init_hybrid_state(de, emb_opt,
+                              {"w": jnp.ones((4, 1), jnp.float32)}, tx,
+                              jax.random.key(seed), mesh=mesh)
+
+    def loss_fn(dp, outs, batch):
+        return sum(batch[:, i % 2].mean() * jnp.mean(o)
+                   for i, o in enumerate(outs)) * jnp.mean(dp["w"])
+
+    step = make_hybrid_train_step(de, loss_fn, tx, emb_opt, mesh=mesh,
+                                  with_metrics=True, nan_guard=True,
+                                  dynamic=SCFG)
+    return de, emb_opt, tx, state, step
+
+
+def _batch(de, i, world):
+    rng = np.random.default_rng(300 + i)
+    B = 2 * world
+    cats = []
+    for cfg in de.strategy.global_configs:
+        if cfg.get("streaming"):
+            cats.append(jnp.asarray(
+                rng.integers(i, i + 5, B) * 13 + 10**7, jnp.int32))
+        else:
+            cats.append(jnp.asarray(
+                rng.integers(0, cfg["input_dim"], B), jnp.int32))
+    return cats, jnp.asarray(rng.normal(size=(B, 2)), jnp.float32)
+
+
+def _run_steps(de, state, step, sstate, n, world, start=0):
+    for i in range(start, start + n):
+        cats, b = _batch(de, i, world)
+        _, state, _, sstate = step(state, cats, b, sstate)
+    return state, sstate
+
+
+def _bitwise(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+def test_save_restore_roundtrip_bitwise(tmp_path):
+    de, emb_opt, tx, state, step = _build(1)
+    sstate = init_streaming(de, SCFG)
+    state, sstate = _run_steps(de, state, step, sstate, 5, 1)
+    ck = str(tmp_path / "ck")
+    enc = smod.encode_state(de, sstate)
+    save_train_state(ck, de, state, aux_states={"streaming": enc})
+    meta = verify_checkpoint(ck)  # aux file is CRC-manifested
+    assert "aux/streaming.npz" in meta["files"]
+    assert meta["aux_states"] == ["streaming"]
+    restored = restore_train_state(ck, de, emb_opt, state.dense_params,
+                                   tx)
+    dec = smod.decode_state(de, init_streaming(de, SCFG),
+                            load_aux_state(ck, "streaming"))
+    assert _bitwise(sstate, dec)
+    # logical content is bitwise: a re-save of the restored state (slab
+    # alignment padding differs in memory, never in a checkpoint)
+    # reproduces every file CRC
+    ck2 = str(tmp_path / "ck2")
+    save_train_state(ck2, de, restored,
+                     aux_states={"streaming": smod.encode_state(de, dec)})
+    assert (verify_checkpoint(ck)["files"]
+            == verify_checkpoint(ck2)["files"])
+
+
+def test_missing_aux_decodes_to_pristine_state(tmp_path):
+    de, emb_opt, tx, state, step = _build(1)
+    sstate = init_streaming(de, SCFG)
+    state, sstate = _run_steps(de, state, step, sstate, 3, 1)
+    ck = str(tmp_path / "ck")
+    save_train_state(ck, de, state)  # pre-streaming-era checkpoint
+    assert load_aux_state(ck, "streaming") is None
+    dec = smod.decode_state(de, sstate, None)
+    assert _bitwise(dec, smod.fresh_like(sstate))
+
+
+def test_torn_head_resumes_aux_from_prev_generation(tmp_path):
+    """When the head checkpoint is torn and restore falls back to
+    ``<dir>.prev``, the streaming aux must come from the SAME (.prev)
+    generation the params did — loading the newer head's slot map onto
+    older tables would splice two trajectories."""
+    de, emb_opt, tx, state, step = _build(1)
+    sstate = init_streaming(de, SCFG)
+    ck = str(tmp_path / "ck")
+    # two generations with DIFFERENT slot-map contents
+    state, sstate = _run_steps(de, state, step, sstate, 2, 1)
+    save_train_state(ck, de, state,
+                     aux_states={"streaming": smod.encode_state(
+                         de, sstate)})
+    prev_enc = smod.encode_state(de, sstate)
+    state, sstate = _run_steps(de, state, step, sstate, 3, 1, start=2)
+    save_train_state(ck, de, state,
+                     aux_states={"streaming": smod.encode_state(
+                         de, sstate)})
+    # tear the head's first table shard (CRC catches it)
+    target = os.path.join(ck, "tables", "table_000.npy")
+    with open(target, "r+b") as f:
+        f.seek(os.path.getsize(target) // 2)
+        byte = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([byte[0] ^ 0xFF]))
+
+    def data(start):
+        return iter(())  # restore only; no further steps
+
+    r = run_resilient(step, _build(1)[3], data, de=de, checkpoint_dir=ck,
+                      resume=True, emb_optimizer=emb_opt, dense_tx=tx,
+                      streaming_state=init_streaming(de, SCFG),
+                      save_on_exit=False, metrics_interval=0)
+    assert r.step == 2  # params came from .prev (step 2), not the head
+    prev_dec = smod.decode_state(de, init_streaming(de, SCFG), prev_enc)
+    assert _bitwise(r.streaming, prev_dec)
+
+
+def test_reshard_8_4_8_roundtrip_bitwise(tmp_path):
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    de8, emb_opt, tx, state, step = _build(8, mesh=mesh)
+    sstate = init_streaming(de8, SCFG, mesh=mesh)
+    state, sstate = _run_steps(de8, state, step, sstate, 4, 8)
+    ck8 = str(tmp_path / "ck8")
+    save_train_state(ck8, de8, state,
+                     aux_states={"streaming": smod.encode_state(
+                         de8, sstate)})
+
+    de4 = DistributedEmbedding(_configs(), world_size=4)
+    ck4 = str(tmp_path / "ck4")
+    reshard_checkpoint(ck8, ck4, de4)
+    # the aux file is plan-agnostic: byte-identical through the rewrite
+    assert (verify_checkpoint(ck8)["files"]["aux/streaming.npz"]
+            == verify_checkpoint(ck4)["files"]["aux/streaming.npz"])
+    # restoring at world 4: slot maps carry over, per-rank sketch resets
+    dec4 = smod.decode_state(de4, init_streaming(de4, SCFG),
+                             load_aux_state(ck4, "streaming"))
+    occ8 = smod.occupancy(de8, sstate)
+    occ4 = smod.occupancy(de4, dec4)
+    assert [t["occupied"] for t in occ4["tables"]] \
+        == [t["occupied"] for t in occ8["tables"]]
+    assert occ4["steps"] == 0  # world changed: counters/sketch warm up
+
+    ck8b = str(tmp_path / "ck8b")
+    reshard_checkpoint(ck4, ck8b, de8)
+    dec8 = smod.decode_state(de8, init_streaming(de8, SCFG, mesh=mesh),
+                             load_aux_state(ck8b, "streaming"))
+    # back on the original topology the FULL state (sketch included)
+    # reproduces bitwise
+    assert _bitwise(sstate, dec8)
+    restored = restore_train_state(ck8b, de8, emb_opt,
+                                   state.dense_params, tx, mesh=mesh)
+    # logical content bitwise: re-saving the restored state reproduces
+    # the original manifest (in-memory slab padding legitimately differs)
+    ck8c = str(tmp_path / "ck8c")
+    save_train_state(ck8c, de8, restored,
+                     aux_states={"streaming": smod.encode_state(
+                         de8, dec8)})
+    assert (verify_checkpoint(ck8)["files"]
+            == verify_checkpoint(ck8c)["files"])
+
+
+def test_rollback_after_eviction_crc_identity(tmp_path):
+    """The mesh NaN-storm drill with a live slot map: the chaos run
+    rolls back to a ring checkpoint (rewinding the slot map from the
+    SAME candidate — the generalized aux rewind), quarantines the
+    poison, and ends CRC-identical (aux/streaming.npz included) to the
+    clean run trained on the stream with the poisoned batch removed."""
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    BAD, STEPS = 5, 10
+
+    def child(ckpt, drop=(), poison=None):
+        de, emb_opt, tx, state, step = _build(8, mesh=mesh)
+        sstate = init_streaming(de, SCFG, mesh=mesh)
+
+        def data(start):
+            idx = [i for i in range(STEPS) if i not in drop]
+            for i in idx[start:]:
+                cats, b = _batch(de, i, 8)
+                if poison is not None and i == poison:
+                    b = b.at[0, 0].set(np.nan)
+                yield cats, b
+
+        r = run_resilient(step, state, data, de=de, checkpoint_dir=ckpt,
+                          checkpoint_every_steps=2, resume=True,
+                          emb_optimizer=emb_opt, dense_tx=tx, mesh=mesh,
+                          streaming_state=sstate, escalate_after=1,
+                          keep_last_n=2, metrics_interval=0)
+        return r
+
+    chaos = str(tmp_path / "chaos")
+    r1 = child(chaos, poison=BAD)
+    assert r1.rollbacks == 1 and r1.quarantined == (BAD,)
+    assert r1.step == STEPS - 1
+    occ = smod.occupancy(_build(8, mesh=mesh)[0], r1.streaming)
+    assert occ["admitted"] > 0  # the drill exercised a live slot map
+
+    clean = str(tmp_path / "clean")
+    r2 = child(clean, drop=(BAD,))
+    assert r2.step == STEPS - 1
+
+    def crcs(ck):
+        with open(os.path.join(ck, "meta.json"), encoding="utf-8") as f:
+            return json.load(f)["files"]
+
+    assert crcs(chaos) == crcs(clean), (
+        "recovered streaming run is not trajectory-exact vs the "
+        "stream-minus-poison run (slot map or params diverged)")

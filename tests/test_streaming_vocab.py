@@ -1,0 +1,436 @@
+"""Streaming-vocab (dynamic-table) mode: admission, eviction, graceful
+degradation, guard interplay, metrics, and recompile hygiene.
+
+The semantics under test (``parallel/streaming.py`` + the
+``DistributedEmbedding(streaming=...)`` remap):
+
+* external ids from an unbounded space serve out of a fixed slab:
+  below the frequency gate they share hash-bucket rows, past it they
+  claim direct-mapped slots (zeroed at claim), and claims on occupied
+  slots only evict colder occupants (approximate LFU);
+* every transition is jit-carried, deterministic, and guard-gated — a
+  nan-guard-skipped step leaves slot map, sketch, counters AND slabs
+  bitwise-unchanged;
+* slot-map churn never retraces the compiled step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_embeddings_tpu.parallel import (
+    DistributedEmbedding, SparseAdagrad, SparseSGD, StreamingConfig,
+    init_hybrid_state, init_streaming, make_hybrid_eval_step,
+    make_hybrid_train_step)
+from distributed_embeddings_tpu.parallel import streaming as smod
+from distributed_embeddings_tpu.utils import obs
+
+
+def _build(configs, world=1, mesh=None, cfg=None, opt=None, **step_kw):
+    de = DistributedEmbedding(configs, world_size=world)
+    emb_opt = opt or SparseSGD()
+    tx = optax.sgd(0.1)
+    state = init_hybrid_state(de, emb_opt,
+                              {"w": jnp.ones((4, 1), jnp.float32)}, tx,
+                              jax.random.key(0), mesh=mesh)
+
+    def loss_fn(dp, outs, batch):
+        return (sum(jnp.mean(o) for o in outs) * jnp.mean(dp["w"])
+                + jnp.mean(batch))
+
+    step = make_hybrid_train_step(de, loss_fn, tx, emb_opt, mesh=mesh,
+                                  dynamic=cfg, **step_kw)
+    return de, state, step
+
+
+def _stream_cfg(**kw):
+    base = dict(admit_min_count=2, evict_margin=1, depth=2, buckets=64)
+    base.update(kw)
+    return StreamingConfig(**base)
+
+
+STATIC = {"input_dim": 32, "output_dim": 4}
+
+
+def streaming_table(capacity=16, buckets=4):
+    return {"input_dim": capacity + buckets, "output_dim": 4,
+            "streaming": {"capacity": capacity, "buckets": buckets}}
+
+
+# ------------------------------------------------------------- validation
+
+
+def test_streaming_config_must_match_input_dim():
+    with pytest.raises(ValueError, match="capacity"):
+        DistributedEmbedding(
+            [STATIC, {"input_dim": 99, "output_dim": 4,
+                      "streaming": {"capacity": 16, "buckets": 4}}],
+            world_size=1)
+
+
+def test_streaming_rejects_sliced_tables():
+    big = {"input_dim": 4096 + 64, "output_dim": 8,
+           "streaming": {"capacity": 4096, "buckets": 64}}
+    small = {"input_dim": 32, "output_dim": 8}
+    with pytest.raises(NotImplementedError, match="sliced"):
+        DistributedEmbedding([small, dict(small), big], world_size=2,
+                             column_slice_threshold=8192)
+    with pytest.raises(NotImplementedError, match="sliced"):
+        DistributedEmbedding([small, dict(small), big], world_size=2,
+                             row_slice=8192)
+
+
+def test_dynamic_arg_requires_a_streaming_table():
+    de, state, step = _build([STATIC, dict(STATIC)], cfg=None)
+    with pytest.raises(ValueError, match="init_streaming"):
+        init_streaming(de, _stream_cfg())
+
+
+def test_resolve_config_rejects_junk():
+    with pytest.raises(TypeError):
+        smod.resolve_config("yes")
+
+
+# ------------------------------------------------- admission and eviction
+
+
+def test_cold_ids_share_buckets_then_admit():
+    cfg = _stream_cfg(admit_min_count=3)
+    de, state, step = _build([STATIC, streaming_table()], cfg=cfg,
+                             with_metrics=True, nan_guard=False)
+    sstate = init_streaming(de, cfg)
+    ext = jnp.full((8,), 7_654_321, jnp.int32)  # one hot external id
+    cats = [jnp.zeros((8,), jnp.int32), ext]
+    batch = jnp.zeros((8,), jnp.float32)
+    # step 1: est jumps to 8 >= 3 -> admitted immediately, but SERVED
+    # from the bucket this step (the slot zeroes at commit)
+    _, state, m, sstate = step(state, cats, batch, sstate)
+    assert float(m["stream_admitted"][0]) == 1
+    assert float(m["stream_bucket_ids"][0]) == 8
+    assert float(m["stream_hit_ids"][0]) == 0
+    # step 2: the id hits its slot
+    _, state, m, sstate = step(state, cats, batch, sstate)
+    assert float(m["stream_admitted"][0]) == 0
+    assert float(m["stream_hit_ids"][0]) == 8
+    occ = smod.occupancy(de, sstate)
+    assert occ["admitted"] == 1 and occ["tables"][0]["occupied"] == 1
+
+
+def test_below_gate_ids_stay_in_buckets():
+    cfg = _stream_cfg(admit_min_count=100)
+    de, state, step = _build([STATIC, streaming_table()], cfg=cfg,
+                             with_metrics=True, nan_guard=False)
+    sstate = init_streaming(de, cfg)
+    rng = np.random.default_rng(0)
+    for i in range(4):
+        cats = [jnp.zeros((8,), jnp.int32),
+                jnp.asarray(rng.integers(0, 1000, 8) + 10**6, jnp.int32)]
+        _, state, m, sstate = step(state, cats,
+                                   jnp.zeros((8,), jnp.float32), sstate)
+        assert float(m["stream_admitted"][0]) == 0
+        assert float(m["stream_bucket_ids"][0]) == 8
+    assert smod.occupancy(de, sstate)["tables"][0]["occupied"] == 0
+
+
+def test_lfu_eviction_hot_id_displaces_cold_occupant():
+    # capacity=1: every external id direct-maps to the single slot, so
+    # the hash collision is guaranteed and eviction is forced
+    cfg = _stream_cfg(admit_min_count=1, evict_margin=1)
+    de, state, step = _build([STATIC, streaming_table(capacity=1,
+                                                      buckets=4)],
+                             cfg=cfg, with_metrics=True, nan_guard=False)
+    sstate = init_streaming(de, cfg)
+    zeros = jnp.zeros((8,), jnp.float32)
+    a = jnp.full((8,), 111, jnp.int32)
+    b = jnp.full((8,), 999, jnp.int32)
+    # id A claims the slot (freq est 8)
+    _, state, m, sstate = step(state, [jnp.zeros((8,), jnp.int32), a],
+                               zeros, sstate)
+    assert float(m["stream_admitted"][0]) == 1
+    # id B arrives once: est 8 < A's 8 + margin -> NO eviction
+    _, state, m, sstate = step(state, [jnp.zeros((8,), jnp.int32), b],
+                               zeros, sstate)
+    assert float(m["stream_evicted"][0]) == 0
+    # id B again: est 16 >= 8 + 1 -> evicts A
+    _, state, m, sstate = step(state, [jnp.zeros((8,), jnp.int32), b],
+                               zeros, sstate)
+    assert float(m["stream_evicted"][0]) == 1
+    # A degrades back to its bucket; B hits the slot
+    both = jnp.concatenate([a[:4], b[:4]])
+    _, state, m, sstate = step(state, [jnp.zeros((8,), jnp.int32), both],
+                               zeros, sstate)
+    assert float(m["stream_hit_ids"][0]) == 4
+    assert float(m["stream_bucket_ids"][0]) == 4
+
+
+def test_admitted_row_zeroes_then_trains():
+    cfg = _stream_cfg(admit_min_count=1)
+    de, state, step = _build([STATIC, streaming_table()], cfg=cfg,
+                             with_metrics=False, nan_guard=False)
+    sstate = init_streaming(de, cfg)
+    ext = jnp.full((8,), 42_424_242, jnp.int32)
+    cats = [jnp.zeros((8,), jnp.int32), ext]
+    batch = jnp.zeros((8,), jnp.float32)
+    _, state, sstate = step(state, cats, batch, sstate)
+    # locate the claimed slot and check its row is exactly zero
+    wkey = f"w{4}"
+    fp = np.asarray(sstate[wkey]["slot_fp"][0])
+    claimed = np.nonzero(fp >= 0)[0]
+    assert claimed.size == 1
+    row = np.asarray(state.emb_params[wkey]).reshape(
+        -1, de.phys_w[4])  # packed rows
+    from distributed_embeddings_tpu.ops import packed_slab as ps
+    logical = ps.unpack_rows_np(
+        np.asarray(state.emb_params[wkey][0]), 4)
+    assert np.all(logical[claimed[0]] == 0.0)
+    # next step the id reads the zeroed slot and its gradient trains it
+    _, state, sstate = step(state, cats, batch, sstate)
+    logical2 = ps.unpack_rows_np(
+        np.asarray(state.emb_params[wkey][0]), 4)
+    assert not np.all(logical2[claimed[0]] == 0.0)
+
+
+def test_duplicate_claims_are_deterministic():
+    # two DIFFERENT hot ids colliding on the single slot in the SAME
+    # batch: the winner must be tie-broken deterministically
+    cfg = _stream_cfg(admit_min_count=1)
+
+    def run():
+        de, state, step = _build([STATIC, streaming_table(capacity=1,
+                                                          buckets=2)],
+                                 cfg=cfg, with_metrics=False,
+                                 nan_guard=False)
+        sstate = init_streaming(de, cfg)
+        ext = jnp.asarray([5, 9] * 4, jnp.int32) + 10**7
+        _, state, sstate = step(
+            state, [jnp.zeros((8,), jnp.int32), ext],
+            jnp.zeros((8,), jnp.float32), sstate)
+        return (np.asarray(sstate["w4"]["slot_fp"]),
+                np.asarray(sstate["w4"]["slot_freq"]))
+    a, b = run(), run()
+    assert np.array_equal(a[0], b[0]) and np.array_equal(a[1], b[1])
+
+
+# --------------------------------------------------- guard + degradation
+
+
+def test_nan_guard_skip_leaves_streaming_state_and_slabs_bitwise():
+    cfg = _stream_cfg(admit_min_count=1)
+    de, state, step = _build([STATIC, streaming_table()], cfg=cfg,
+                             opt=SparseAdagrad(), with_metrics=True,
+                             nan_guard=True)
+    sstate = init_streaming(de, cfg)
+    good = jnp.zeros((8,), jnp.float32)
+    cats = [jnp.zeros((8,), jnp.int32),
+            jnp.full((8,), 123, jnp.int32)]
+    _, state, m, sstate = step(state, cats, good, sstate)
+    before = jax.tree.map(np.asarray,
+                          (state.emb_params, state.emb_opt_state, sstate))
+    # poisoned batch with NOVEL ids: transitions must be fully gated
+    cats2 = [jnp.zeros((8,), jnp.int32),
+             jnp.full((8,), 987_654, jnp.int32)]
+    _, state, m, sstate = step(state, cats2,
+                               jnp.full((8,), np.nan, jnp.float32),
+                               sstate)
+    assert float(m["skipped_steps"][0]) == 1
+    assert float(m["stream_admitted"][0]) == 0
+    after = jax.tree.map(np.asarray,
+                         (state.emb_params, state.emb_opt_state, sstate))
+    for x, y in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        assert np.array_equal(x, y)
+
+
+def test_oov_flood_degrades_gracefully():
+    # a burst of never-seen ids must neither crash nor evict the hot set
+    cfg = _stream_cfg(admit_min_count=3, evict_margin=2)
+    de, state, step = _build([STATIC, streaming_table(capacity=8,
+                                                      buckets=4)],
+                             cfg=cfg, with_metrics=True, nan_guard=False)
+    sstate = init_streaming(de, cfg)
+    zeros = jnp.zeros((8,), jnp.float32)
+    hot = jnp.full((8,), 777, jnp.int32)
+    for _ in range(3):  # establish the hot id
+        _, state, m, sstate = step(
+            state, [jnp.zeros((8,), jnp.int32), hot], zeros, sstate)
+    occupied = smod.occupancy(de, sstate)["tables"][0]["occupied"]
+    assert occupied == 1
+    flood = jnp.asarray(np.arange(8) + 2_000_000_000 - 8, jnp.int32)
+    _, state, m, sstate = step(
+        state, [jnp.zeros((8,), jnp.int32), flood], zeros, sstate)
+    occ = smod.occupancy(de, sstate)
+    assert occ["evicted"] == 0  # one-shot ids never beat the gate
+    # the hot id still hits its slot afterwards
+    _, state, m, sstate = step(
+        state, [jnp.zeros((8,), jnp.int32), hot], zeros, sstate)
+    assert float(m["stream_hit_ids"][0]) == 8
+
+
+def test_eval_step_is_read_only():
+    cfg = _stream_cfg(admit_min_count=1)
+    de, state, step = _build([STATIC, streaming_table()], cfg=cfg,
+                             with_metrics=False, nan_guard=False)
+    sstate = init_streaming(de, cfg)
+    cats = [jnp.zeros((8,), jnp.int32), jnp.full((8,), 31337, jnp.int32)]
+    _, state, sstate = step(state, cats, jnp.zeros((8,), jnp.float32),
+                            sstate)
+    ev = make_hybrid_eval_step(
+        de, lambda dp, outs, b: sum(jnp.mean(o, -1) for o in outs),
+        dynamic=cfg)
+    before = jax.tree.map(np.asarray, sstate)
+    novel = [jnp.zeros((8,), jnp.int32),
+             jnp.full((8,), 999_999, jnp.int32)]
+    preds = ev(state, novel, None, sstate)
+    assert np.isfinite(np.asarray(preds)).all()
+    for x, y in zip(jax.tree.leaves(before),
+                    jax.tree.leaves(jax.tree.map(np.asarray, sstate))):
+        assert np.array_equal(x, y)
+
+
+def test_ragged_streaming_input():
+    # multi-hot ragged features route through the same remap: values
+    # remap, lengths/padding stay byte-identical, dead positions inert
+    from distributed_embeddings_tpu.ops.embedding_lookup import Ragged
+
+    cfg = _stream_cfg(admit_min_count=1)
+    configs = [STATIC,
+               {"input_dim": 16 + 4, "output_dim": 4, "combiner": "sum",
+                "streaming": {"capacity": 16, "buckets": 4}}]
+    de = DistributedEmbedding(configs, world_size=1)
+    emb_opt = SparseSGD()
+    tx = optax.sgd(0.1)
+    state = init_hybrid_state(de, emb_opt,
+                              {"w": jnp.ones((4, 1), jnp.float32)}, tx,
+                              jax.random.key(0))
+
+    def loss_fn(dp, outs, batch):
+        return (sum(jnp.mean(o) for o in outs) * jnp.mean(dp["w"])
+                + jnp.mean(batch))
+
+    step = make_hybrid_train_step(de, loss_fn, tx, emb_opt,
+                                  with_metrics=True, nan_guard=False,
+                                  dynamic=cfg)
+    sstate = init_streaming(de, cfg)
+    rag = Ragged(values=jnp.asarray([11, 11, 11, 22, 22, 0, 0, 0],
+                                    jnp.int32) + 10**6,
+                 row_splits=jnp.asarray([0, 3, 5, 5, 5], jnp.int32))
+    cats = [jnp.zeros((4,), jnp.int32), rag]
+    batch = jnp.zeros((4,), jnp.float32)
+    loss, state, m, sstate = step(state, cats, batch, sstate)
+    assert np.isfinite(float(loss))
+    # only the 5 LIVE ragged positions count (padding is inert)
+    assert float(m["stream_bucket_ids"][0]) == 5
+    assert float(m["stream_admitted"][0]) == 2  # ids 11+1e6 and 22+1e6
+    loss, state, m, sstate = step(state, cats, batch, sstate)
+    assert float(m["stream_hit_ids"][0]) == 5
+
+
+# ------------------------------------------------------ recompile hygiene
+
+
+def test_slot_map_churn_does_not_retrace():
+    cfg = _stream_cfg(admit_min_count=1)
+    de, state, step = _build([STATIC, streaming_table(capacity=8,
+                                                      buckets=4)],
+                             cfg=cfg, with_metrics=True, nan_guard=True)
+    sstate = init_streaming(de, cfg)
+    obs.install_compile_listener()
+    rng = np.random.default_rng(3)
+
+    def one(i):
+        cats = [jnp.asarray(rng.integers(0, 32, 8), jnp.int32),
+                jnp.asarray(rng.integers(0, 10**6, 8), jnp.int32)]
+        return step(state, cats, jnp.zeros((8,), jnp.float32), sstate)
+
+    _, state, m, sstate = one(0)  # warmup compile
+    c0 = obs.counters().get("recompiles", 0)
+    for i in range(4):  # heavy admission/eviction churn
+        _, state, m, sstate = one(i + 1)
+    jax.block_until_ready(jax.tree.leaves(sstate))
+    assert obs.counters().get("recompiles", 0) - c0 == 0
+
+
+def test_train_loop_carries_streaming_state():
+    from distributed_embeddings_tpu.parallel import make_hybrid_train_loop
+
+    cfg = _stream_cfg(admit_min_count=2)
+    configs = [STATIC, streaming_table()]
+    de = DistributedEmbedding(configs, world_size=1)
+    emb_opt = SparseSGD()
+    tx = optax.sgd(0.1)
+    state = init_hybrid_state(de, emb_opt,
+                              {"w": jnp.ones((4, 1), jnp.float32)}, tx,
+                              jax.random.key(0))
+
+    def loss_fn(dp, outs, batch):
+        return sum(jnp.mean(o) for o in outs) * jnp.mean(dp["w"])
+
+    loop = make_hybrid_train_loop(de, loss_fn, tx, emb_opt,
+                                  with_metrics=True, nan_guard=True,
+                                  dynamic=cfg)
+    sstate = init_streaming(de, cfg)
+    K = 4
+    cat_stacks = [jnp.zeros((K, 8), jnp.int32),
+                  jnp.broadcast_to(jnp.full((8,), 55_555, jnp.int32),
+                                   (K, 8))]
+    batch_stacks = jnp.zeros((K, 8), jnp.float32)
+    losses, state, metrics, sstate = loop(state, cat_stacks,
+                                          batch_stacks, sstate)
+    assert losses.shape == (K,)
+    adm = np.asarray(metrics["stream_admitted"]).reshape(K)
+    hits = np.asarray(metrics["stream_hit_ids"]).reshape(K)
+    # the id admits on the first scanned step and hits from the second —
+    # ONE carried slot map across the whole compiled dispatch
+    assert adm[0] == 1 and adm[1:].sum() == 0
+    assert hits[0] == 0 and all(hits[1:] == 8)
+    occ = smod.occupancy(de, sstate)
+    assert occ["steps"] == K and occ["admitted"] == 1
+
+
+# ------------------------------------------------------------- 8-dev mesh
+
+
+def test_streaming_on_mesh_with_telemetry_combined():
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    cfg = _stream_cfg(admit_min_count=2)
+    configs = [{"input_dim": 24 + 3 * i, "output_dim": 8}
+               for i in range(7)]
+    configs.append({"input_dim": 64 + 8, "output_dim": 8,
+                    "streaming": {"capacity": 64, "buckets": 8}})
+    de = DistributedEmbedding(configs, world_size=8)
+    emb_opt = SparseSGD()
+    tx = optax.sgd(0.1)
+    state = init_hybrid_state(de, emb_opt,
+                              {"w": jnp.ones((8, 1), jnp.float32)}, tx,
+                              jax.random.key(0), mesh=mesh)
+
+    def loss_fn(dp, outs, batch):
+        return sum(jnp.mean(o) for o in outs) * jnp.mean(dp["w"])
+
+    from distributed_embeddings_tpu.analysis import telemetry as tel
+    tcfg = tel.TelemetryConfig(depth=2, buckets=128, topk=8,
+                               candidates=16)
+    step = make_hybrid_train_step(de, loss_fn, tx, emb_opt, mesh=mesh,
+                                  with_metrics=True, nan_guard=True,
+                                  telemetry=tcfg, dynamic=cfg)
+    telem = tel.init_telemetry(de, tcfg, mesh=mesh)
+    sstate = init_streaming(de, cfg, mesh=mesh)
+    rng = np.random.default_rng(5)
+    B = 16
+    for i in range(3):
+        cats = [jnp.asarray(rng.integers(0, c["input_dim"], B), jnp.int32)
+                for c in configs[:7]]
+        cats.append(jnp.asarray(rng.integers(0, 40, B) + 10**7,
+                                jnp.int32))
+        loss, state, metrics, telem, sstate = step(
+            state, cats, jnp.zeros((B,), jnp.float32), telem, sstate)
+    assert np.isfinite(float(loss))
+    assert float(np.asarray(metrics["stream_admitted"]).sum()) > 0
+    for k in obs.STREAMING_METRIC_KEYS:
+        assert np.asarray(metrics[k]).shape == (8,)
+    occ = smod.occupancy(de, sstate)
+    assert occ["admitted"] > 0
+    assert occ["tables"][0]["table_id"] == 7

@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Verify gate for the streaming-vocab (dynamic-table) mode (run by
+``make check-streaming`` inside ``make verify``) — the non-stationary-
+traffic drill.
+
+CPU end-to-end, deterministic, no backend required beyond the CPU one:
+
+1. spawn a child training driver (one static + one streaming table, 12
+   batches of drifting never-in-vocab external ids through
+   ``parallel.resilient.run_resilient`` with the jit-carried slot map)
+   under ``DETPU_FAULT=oovflood@3,preempt@6`` — batch 3 floods the
+   stream with a burst of never-before-seen ids (the admission/bucket
+   machinery must absorb it: no crash, ids served from the shared
+   buckets) and at step 6 the driver self-SIGTERMs, checkpoints
+   (slot map + sketch riding INSIDE the checkpoint as
+   ``aux/streaming.npz``), and exits preempted;
+2. re-run the same child (auto-resume): it must restore the slot-map
+   state from the checkpoint and run to clean completion with real
+   ADMISSIONS having happened and ZERO steady-state recompiles (three
+   extra manual steps of novel ids after the run re-use the compiled
+   step — slot-map churn must never retrace);
+3. run the identical stream uninterrupted in a fresh directory and
+   assert both final checkpoints are CRC-identical, ``aux/streaming.npz``
+   included — the interrupted+resumed streaming run reproduces the
+   uninterrupted trajectory (params AND slot map) bit for bit.
+
+Exit 0 when the drill passes; 1 with a readable reason otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+STEPS = 12
+FLOOD = 3    # stream position the oovflood@ drill floods
+PREEMPT = 6  # step the preempt@ drill SIGTERMs at
+
+_CHILD = """
+import sys
+sys.path.insert(0, {repo!r})
+import jax, optax, numpy as np, jax.numpy as jnp
+jax.config.update('jax_platforms', 'cpu')
+from distributed_embeddings_tpu.parallel import (
+    DistributedEmbedding, SparseAdagrad, StreamingConfig,
+    init_hybrid_state, init_streaming, make_hybrid_train_step,
+    run_resilient)
+from distributed_embeddings_tpu.parallel import streaming as smod
+from distributed_embeddings_tpu.utils import obs
+obs.install_compile_listener()
+configs = [
+    {{"input_dim": 20, "output_dim": 4}},
+    {{"input_dim": 32 + 8, "output_dim": 4,
+      "streaming": {{"capacity": 32, "buckets": 8}}}},
+]
+de = DistributedEmbedding(configs, world_size=1)
+cfg = StreamingConfig(admit_min_count=2, evict_margin=1,
+                      depth=2, buckets=256)
+emb_opt = SparseAdagrad()
+tx = optax.sgd(0.05)
+state = init_hybrid_state(de, emb_opt,
+                          {{"w": jnp.ones((4, 1), jnp.float32)}},
+                          tx, jax.random.key(0))
+sstate = init_streaming(de, cfg)
+def loss_fn(dp, outs, batch):
+    return sum(batch[:, i].mean() * jnp.mean(o)
+               for i, o in enumerate(outs)) * jnp.mean(dp["w"])
+def make_batch(i):
+    rng = np.random.default_rng(900 + i)
+    # a slowly drifting external-id distribution: day-k ids give way to
+    # day-k+1 ids, far outside any static vocab
+    cats = [jnp.asarray(rng.integers(0, 20, 8), jnp.int32),
+            jnp.asarray(rng.integers(i, i + 6, 8) * 7 + 10_000_000,
+                        jnp.int32)]
+    return cats, jnp.asarray(rng.normal(size=(8, 2)), jnp.float32)
+def data(start):
+    for i in range(start, {steps}):
+        yield make_batch(i)
+step = make_hybrid_train_step(de, loss_fn, tx, emb_opt,
+                              with_metrics=True, nan_guard=True,
+                              dynamic=cfg)
+r = run_resilient(step, state, data, de=de, checkpoint_dir={ckpt!r},
+                  checkpoint_every_steps=2, resume=True,
+                  emb_optimizer=emb_opt, dense_tx=tx,
+                  streaming_state=sstate, metrics_interval=0)
+occ = smod.occupancy(de, r.streaming)
+steady = 0
+if not r.preempted:
+    # steady-state recompile proof: more steps of NOVEL ids against the
+    # already-compiled step — slot-map churn must not retrace
+    c0 = obs.counters().get("recompiles", 0)
+    st, ss = r.state, r.streaming
+    for j in range(3):
+        cats, b = make_batch(1000 + j)
+        _, st, _, ss = step(st, cats, b, ss)
+    jax.block_until_ready(jax.tree.leaves(ss))
+    steady = obs.counters().get("recompiles", 0) - c0
+print("FINAL", r.step, "PREEMPTED", int(r.preempted),
+      "ADMITTED", int(occ["admitted"]), "EVICTED", int(occ["evicted"]),
+      "BUCKET", int(occ["bucket_ids"]), "STEADY", steady, flush=True)
+"""
+
+
+def _run_child(ckpt, fault=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for k in ("DETPU_FAULT", "DETPU_OBS", "DETPU_TELEMETRY"):
+        env.pop(k, None)
+    env["DETPU_CKPT_RING"] = "2"
+    if fault:
+        env["DETPU_FAULT"] = fault
+    code = _CHILD.format(repo=REPO, ckpt=ckpt, steps=STEPS)
+    return subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=600)
+
+
+def _final_crcs(ckpt):
+    with open(os.path.join(ckpt, "meta.json"), encoding="utf-8") as f:
+        return json.load(f)["files"]
+
+
+def _parse(stdout):
+    for line in reversed(stdout.strip().splitlines()):
+        if line.startswith("FINAL"):
+            parts = line.split()
+            return dict(zip(parts[::2], parts[1::2]))
+    return None
+
+
+def main() -> int:
+    errors = []
+    with tempfile.TemporaryDirectory(prefix="detpu_streaming_") as tmp:
+        ckpt = os.path.join(tmp, "ck")
+
+        # 1: flood + preempt — must checkpoint (slot map inside) and exit
+        p = _run_child(ckpt, fault=f"oovflood@{FLOOD},preempt@{PREEMPT}")
+        if p.returncode != 0:
+            return _fail([f"preempt child failed rc={p.returncode}: "
+                          f"{(p.stderr or p.stdout).strip()[-800:]}"])
+        got = _parse(p.stdout)
+        if not got or got.get("PREEMPTED") != "1":
+            errors.append(f"child did not report a preemption: {got}")
+        if not os.path.isfile(os.path.join(ckpt, "aux", "streaming.npz")):
+            errors.append("preemption checkpoint carries no "
+                          "aux/streaming.npz slot-map snapshot")
+
+        # 2: resume — clean completion, admissions happened, 0 recompiles
+        p2 = _run_child(ckpt, fault=f"oovflood@{FLOOD}")
+        if p2.returncode != 0:
+            return _fail([f"resume child failed rc={p2.returncode}: "
+                          f"{(p2.stderr or p2.stdout).strip()[-800:]}"])
+        got2 = _parse(p2.stdout)
+        if not got2 or got2.get("FINAL") != str(STEPS):
+            errors.append(f"resume child ended at {got2} — want FINAL "
+                          f"{STEPS}")
+        elif got2.get("PREEMPTED") != "0":
+            errors.append("resume child reported preempted")
+        elif int(got2.get("ADMITTED", 0)) <= 0:
+            errors.append("no slot admissions happened across the run — "
+                          "the frequency gate never fired")
+        elif int(got2.get("STEADY", 1)) != 0:
+            errors.append(
+                f"{got2['STEADY']} steady-state recompile(s): slot-map "
+                "churn retraces the compiled step")
+        if errors:
+            return _fail(errors)
+
+        # 3: CRC-identity vs the uninterrupted run (aux included)
+        ref = os.path.join(tmp, "ref")
+        p3 = _run_child(ref, fault=f"oovflood@{FLOOD}")
+        if p3.returncode != 0:
+            return _fail([f"reference child failed rc={p3.returncode}: "
+                          f"{(p3.stderr or p3.stdout).strip()[-800:]}"])
+        crcs, ref_crcs = _final_crcs(ckpt), _final_crcs(ref)
+        if crcs != ref_crcs:
+            diff = sorted(k for k in set(crcs) | set(ref_crcs)
+                          if crcs.get(k) != ref_crcs.get(k))
+            errors.append(
+                "final checkpoints differ between the interrupted+resumed "
+                f"run and the uninterrupted run (files {diff}) — the "
+                "streaming trajectory (params and/or slot map) is not "
+                "preemption-deterministic")
+    if errors:
+        return _fail(errors)
+    print(f"check_streaming: OK (oovflood@{FLOOD} absorbed into the "
+          f"shared buckets, admissions happened, preempt@{PREEMPT} -> "
+          f"resume reached step {STEPS} with 0 steady-state recompiles "
+          "and a final checkpoint CRC-identical — aux/streaming.npz "
+          "included — to the uninterrupted run)")
+    return 0
+
+
+def _fail(errors) -> int:
+    for e in errors:
+        print(f"check_streaming: {e}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -53,6 +53,7 @@ THROUGHPUT_KEYS = (
     "resilient_samples_per_sec",
     "sentinel_samples_per_sec",
     "telemetry_samples_per_sec",
+    "streaming_samples_per_sec",
 )
 # lower is better (ms-per-iter timings and byte budgets: a >threshold
 # rise in per-step peak HBM is a regression exactly like a slower step)
@@ -273,11 +274,53 @@ def check_plan_audit(old: Dict[str, Any], new: Dict[str, Any]) -> int:
     return failures
 
 
+#: streaming section contract: the capacity-bounded dynamic table must
+#: keep TRACKING the static-vocab AUC on the day-k/day-k+1 replay (and
+#: actually exercise its admission machinery) — the scenario's whole
+#: point is matching quality at a fraction of the HBM
+STREAMING_MAX_AUC_DROP = 0.02
+
+
+def check_streaming(old: Dict[str, Any], new: Dict[str, Any]) -> int:
+    """Gate the ``streaming`` section: a candidate carrying it must show
+    a dynamic-vs-static AUC delta within :data:`STREAMING_MAX_AUC_DROP`,
+    nonzero admissions, and a dynamic HBM footprint genuinely below the
+    static plan's; a candidate MISSING the section while the baseline
+    has it fails (the scenario silently disappeared)."""
+    sec = new.get("streaming")
+    if not isinstance(sec, dict):
+        if isinstance(old.get("streaming"), dict):
+            print("compare_bench: candidate has no 'streaming' section "
+                  "but the baseline does — the streaming scenario failed "
+                  "or was dropped", file=sys.stderr)
+            return 1
+        return 0
+    failures = 0
+    delta = sec.get("auc_delta_vs_static")
+    if isinstance(delta, (int, float)) and delta < -STREAMING_MAX_AUC_DROP:
+        print(f"compare_bench: streaming dynamic table trails the static "
+              f"vocab by {-delta:.4f} AUC on the day-k+1 eval (> "
+              f"{STREAMING_MAX_AUC_DROP} allowed)", file=sys.stderr)
+        failures += 1
+    if not sec.get("admitted"):
+        print("compare_bench: streaming section reports zero admissions "
+              "— the frequency gate never fired", file=sys.stderr)
+        failures += 1
+    frac = sec.get("hbm_frac_of_static")
+    if isinstance(frac, (int, float)) and frac >= 1.0:
+        print(f"compare_bench: streaming plan prices at {frac:.2f}x the "
+              "static plan's HBM — the capacity bound is not bounding",
+              file=sys.stderr)
+        failures += 1
+    return failures
+
+
 def compare(old: Dict[str, Any], new: Dict[str, Any],
             threshold: float) -> int:
     steady_failures = check_steady_state(new)
     steady_failures += check_phase_budget(old, new)
     steady_failures += check_plan_audit(old, new)
+    steady_failures += check_streaming(old, new)
     regressions = 0
     rows = []
     for keys, higher_better in ((THROUGHPUT_KEYS, True), (MS_KEYS, False)):
