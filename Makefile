@@ -4,9 +4,9 @@
 SHELL := /bin/bash
 
 .PHONY: all native test test-fast bench bench-diff clean pkg verify \
-        lint plan-audit audit-step hlo-audit check-backend check-obs \
-        check-obs-report check-resilience check-reshard check-recovery \
-        check-streaming obs-report
+        lint plan-audit audit-step hlo-audit schedule-audit check-backend \
+        check-obs check-obs-report check-resilience check-reshard \
+        check-recovery check-streaming obs-report
 
 all: native
 
@@ -28,9 +28,9 @@ bench:
 # plus the static gates (detlint rules, the SPMD step auditor, the legacy
 # no-eager-backend shim), the observability gate, and the
 # preemption-recovery drill — run before shipping a round
-verify: lint plan-audit audit-step hlo-audit check-backend check-obs \
-        check-obs-report check-resilience check-reshard check-recovery \
-        check-streaming
+verify: lint plan-audit audit-step hlo-audit schedule-audit check-backend \
+        check-obs check-obs-report check-resilience check-reshard \
+        check-recovery check-streaming
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -64,6 +64,15 @@ audit-step:
 # float convert round-trips; analysis/hlo_census.py)
 hlo-audit:
 	env JAX_PLATFORMS=cpu python tools/hlo_audit.py --strict
+
+# schedule-graph auditor: compiles the hybrid step abstractly (incl. the
+# streaming and Criteo-1TB cases), builds the dependency DAG of the
+# optimized HLO, prices the critical path on the v5e cost model, and
+# enforces the serialized-a2a baseline contracts + the StepSchedule
+# overlap declaration check; self-drills a fake overlap-declaring
+# schedule (analysis/schedule_audit.py)
+schedule-audit:
+	env JAX_PLATFORMS=cpu python tools/schedule_audit.py --strict
 
 # fails if __graft_entry__.py / bench.py reintroduce a pre-probe backend
 # touch (the r5 rc=124 root cause); thin shim over the detlint rule

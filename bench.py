@@ -44,6 +44,7 @@ CPU-testable toys (same code paths) for the fault-injection tests.
 
 import json
 import os
+import sys
 import time
 
 import jax
@@ -954,6 +955,75 @@ def run_phase_budget():
     }
 
 
+def run_schedule():
+    """Schedule-graph baseline of the compiled step (the overlap
+    ratchet's anchor): runs ``tools/schedule_audit.py`` in a CHILD
+    process pinned to the virtual-device CPU backend (the static audit
+    must never touch — or wait on — this process's accelerator tunnel)
+    and embeds the dependency-DAG report: per-collective
+    serialized/overlappable classification, the modeled critical path,
+    and ``serialized_collective_fraction``. ``tools/compare_bench.py::
+    check_schedule`` fails any candidate whose fraction or critical-path
+    bytes GROW versus the baseline — overlap, once won, can never
+    silently regress. Smoke mode audits the headline (dense) case only;
+    full runs add the Criteo-1TB deployment shapes."""
+    import subprocess
+    import tempfile
+
+    cfgs = ["dense"] if SMOKE else ["dense", "criteo1tb"]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    cases = {}
+    violations = []
+    for cfg in cfgs:
+        with tempfile.NamedTemporaryFile(
+                mode="r", suffix=".json", delete=False) as tf:
+            json_path = tf.name
+        try:
+            proc = subprocess.run(
+                [sys.executable,
+                 os.path.join("tools", "schedule_audit.py"),
+                 "--config", cfg, "--no-drill", "--json", json_path],
+                capture_output=True, text=True, timeout=600, env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"schedule_audit --config {cfg} rc={proc.returncode}: "
+                    f"{proc.stderr[-500:]}")
+            with open(json_path, encoding="utf-8") as fh:
+                reports = json.load(fh)
+        finally:
+            try:
+                os.unlink(json_path)
+            except OSError:
+                pass
+        for rep in reports:
+            cases[rep["label"]] = {
+                "serialized_collective_fraction":
+                    rep["serialized_collective_fraction"],
+                "critical_path_ns": rep["critical_path_ns"],
+                "critical_path_bytes": rep["critical_path_bytes"],
+                "collectives": [
+                    {"phase": c["phase"],
+                     "classification": c["classification"],
+                     "on_critical_path": c["on_critical_path"]}
+                    for c in rep["collectives"]
+                    if c["op"] == "all-to-all"],
+            }
+            violations += rep["violations"]
+    head = next(iter(cases.values()))
+    return {
+        # headline (dense/world8) numbers — what check_schedule ratchets
+        "serialized_collective_fraction":
+            head["serialized_collective_fraction"],
+        "critical_path_bytes": head["critical_path_bytes"],
+        "critical_path_ns": head["critical_path_ns"],
+        "cases": cases,
+        "violations": violations,
+    }
+
+
 def run_telemetry_overhead():
     """Access-telemetry cost (ISSUE 5): the SAME single-chip DLRM step
     timed with the jit-carried telemetry compiled OUT (the headline
@@ -1456,6 +1526,13 @@ def main():
         # candidate whose per-phase gated pass counts regress (and any
         # record whose own pass-budget contracts are violated)
         out["phase_budget"] = pb
+    sched = _guard("schedule", run_schedule)
+    if sched is not None:
+        # the dependency-DAG baseline rides the record so
+        # tools/compare_bench.py can fail a candidate whose
+        # serialized_collective_fraction or modeled critical-path bytes
+        # grow (the overlap ratchet)
+        out["schedule"] = sched
     telov = _guard("telemetry_overhead", run_telemetry_overhead)
     if telov is not None:
         out["telemetry_overhead"] = telov

@@ -17,6 +17,16 @@ above: a backend-free byte/comms model of a placement plan, enforced as
 :class:`~.plan_audit.PlanContract` s by ``tools/plan_audit.py`` — incl.
 the chip capacity registry). Fused into one run report by
 ``tools/obs_report.py``.
+
+:mod:`.schedule_audit` sees what none of the above can: the DEPENDENCY
+STRUCTURE of the optimized step. It parses operands out of the compiled
+HLO, builds the full dependency DAG, prices every node under a
+bytes-based cost model (chips from :data:`~.plan_audit.CHIP_SPECS`),
+computes the critical path, and classifies each collective as
+serialized-on or overlappable-with dense compute — enforced as
+:class:`~.schedule_audit.ScheduleContract` s and as the
+:class:`~..parallel.schedule.StepSchedule` declaration check by
+``tools/schedule_audit.py --strict`` (= ``make schedule-audit``).
 """
 
 from .audit import (
@@ -53,6 +63,16 @@ from .plan_audit import (
     compare_with_memory,
     default_contract,
     rank_strategies,
+)
+from . import schedule_audit
+from .schedule_audit import (
+    CollectiveInfo,
+    ScheduleContract,
+    ScheduleGraph,
+    ScheduleGraphError,
+    ScheduleReport,
+    baseline_contracts,
+    parse_hlo_module,
 )
 from .telemetry import (
     TelemetryConfig,
@@ -97,4 +117,12 @@ __all__ = [
     "compare_with_memory",
     "default_contract",
     "rank_strategies",
+    "schedule_audit",
+    "CollectiveInfo",
+    "ScheduleContract",
+    "ScheduleGraph",
+    "ScheduleGraphError",
+    "ScheduleReport",
+    "baseline_contracts",
+    "parse_hlo_module",
 ]

@@ -455,6 +455,7 @@ def build_abstract_step(de: DistributedEmbedding,
                         with_metrics: Optional[bool] = None,
                         nan_guard: Optional[bool] = None,
                         telemetry=None,
+                        dynamic=None,
                         dense_params=None,
                         state=None):
     """Build the hybrid train step EXACTLY like
@@ -469,12 +470,16 @@ def build_abstract_step(de: DistributedEmbedding,
     step. ``with_metrics``/``nan_guard`` default from the env (the step
     builder's convention); ``state`` is derived via ``eval_shape`` from
     ``dense_params`` when omitted; a telemetry config appends the
-    abstract carried state as the fourth argument.
+    abstract carried state as the fourth argument, and a streaming
+    config (``dynamic=``, the step builder's argument) the abstract
+    slot-map/sketch state after it — the aux order of
+    :data:`~..parallel.trainer.AUX_ARG_REGISTRY`.
 
     Returns:
       ``(step, args, state, tel_cfg, with_metrics, nan_guard)``.
     """
     from ..utils import obs
+    from ..parallel import streaming as streaming_mod
     from . import telemetry as tel
 
     if with_metrics is None:
@@ -482,6 +487,7 @@ def build_abstract_step(de: DistributedEmbedding,
     if nan_guard is None:
         nan_guard = obs.nanguard_enabled()
     tel_cfg = tel.resolve_config(telemetry)
+    dyn_cfg = streaming_mod.resolve_config(dynamic)
 
     if state is None:
         if dense_params is None:
@@ -496,12 +502,16 @@ def build_abstract_step(de: DistributedEmbedding,
     step = trainer_mod.make_hybrid_train_step(
         de, loss_fn, dense_tx, emb_optimizer, mesh=mesh,
         lr_schedule=lr_schedule, with_metrics=with_metrics,
-        nan_guard=nan_guard, telemetry=tel_cfg if tel_cfg else False)
+        nan_guard=nan_guard, telemetry=tel_cfg if tel_cfg else False,
+        dynamic=dyn_cfg if dyn_cfg else False)
 
     args: Tuple[Any, ...] = (state, cat_inputs, batch)
     if tel_cfg is not None:
         args = args + (jax.eval_shape(
             lambda: tel.init_telemetry(de, tel_cfg)),)
+    if dyn_cfg is not None:
+        args = args + (jax.eval_shape(
+            lambda: streaming_mod.init_streaming(de, dyn_cfg)),)
     return step, args, state, tel_cfg, with_metrics, nan_guard
 
 

@@ -35,6 +35,12 @@ from .resilient import (
     resume_sentinel_path,
     run_resilient,
 )
+from .schedule import (
+    PhaseDecl,
+    ScheduleError,
+    StepSchedule,
+    default_schedule,
+)
 from .streaming import (
     StreamingConfig,
     init_streaming,

@@ -1,0 +1,246 @@
+"""Apply layer of the hybrid step: the manual sparse backward and the
+per-width optimizer scatters.
+
+One of the three executor modules the ``dist_embedding.py`` monolith
+split into (:mod:`.exchange` / :mod:`.lookup` / apply). This module owns
+everything after the dense backward: inverting the output collapse back
+to worker order, packing the cotangent blocks for the reverse exchange
+(:func:`~.exchange.pack_grad_blocks` + :func:`~.exchange.exchange_grads`),
+rebuilding the per-group id streams from the forward residual, and the
+ONE optimizer scatter per width slab (:func:`apply_width_streams`, the
+:data:`~.schedule.PHASE_APPLY` phase family — ``sparse_apply_w{k}``).
+
+Every function takes the owning
+:class:`~.dist_embedding.DistributedEmbedding` as its first argument;
+the split is pure code motion — the traced program is bit-for-bit what
+the monolith's methods produced.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils import obs
+from ..ops import packed_slab as ps
+from . import exchange as exchange_mod
+from . import lookup as lookup_mod
+from .lookup import _wkey
+
+
+def apply_width_streams(de, params, opt_state,
+                        per_width: Dict[str, List], optimizer, lr,
+                        scale, enable=None):
+    """Concatenate each width's (logical ids, update rows) stream,
+    lane-expand to physical full-tile rows, and run ONE optimizer scatter
+    per width slab. Stateful-moment optimizers additionally receive the
+    lane touch-mask (``ops/packed_slab.py:expand_touch_mask``) so packed
+    neighbour rows keep their state.
+
+    ``enable`` (scalar bool, traced): when False every update row is
+    routed to the dropped sentinel — the scatters drop out of bounds,
+    so the slabs AND every slab-shaped optimizer state component stay
+    bitwise-unchanged. This is the non-finite guard's skip path: an
+    O(ids) mask instead of a slab-wide select (which would read+write
+    gigabytes of tables per step just to discard the result)."""
+    new_params = dict(params)
+    new_state = dict(opt_state) if isinstance(opt_state, dict) else opt_state
+    wants_mask = getattr(optimizer, "needs_touch_mask", False)
+    for k in sorted(per_width):
+        with obs.scope(f"sparse_apply_{k}"):
+            tris = per_width[k]
+            w = tris[0][2]
+            ids = jnp.concatenate([t[0].reshape(-1) for t in tris])
+            if enable is not None:
+                # disabled step: all rows -> logical sentinel (the same
+                # dropped-row id the backward uses for OOB ids)
+                ids = jnp.where(enable, ids,
+                                jnp.asarray(de.rows_cap[w], ids.dtype))
+            vals = jnp.concatenate(
+                [t[1].reshape(-1, w) for t in tris]) * scale
+            # lane-expand to physical rows: the scatter (and any dedup
+            # in the optimizer) runs on full-tile rows; lane-disjoint
+            # placement keeps per-logical-row semantics exact
+            # (ops/packed_slab.py)
+            phys_ids, pvals = ps.expand_update_rows(vals, ids, w)
+            kw = {}
+            if wants_mask:
+                # compact [n, p] lane mask rides the optimizer's dedup
+                # and expands to lanes after
+                # (ops/packed_slab.py:lane_one_hot)
+                m = ps.lane_one_hot(ids, w, dtype=pvals.dtype)
+                if m is not None:
+                    kw["mask"] = m
+                    kw["lane_width"] = w
+            slab = new_params[k]
+            st = (new_state[k] if isinstance(new_state, dict)
+                  else new_state)
+            slab, st = optimizer.apply_rows(slab, st, phys_ids, pvals,
+                                            lr, **kw)
+            new_params[k] = slab
+            if isinstance(new_state, dict):
+                new_state[k] = st
+    return new_params, new_state
+
+
+def sparse_apply_gradients(de, params, opt_state, residuals, out_grads,
+                           optimizer, lr, scale=None, enable=None):
+    """Manual sparse backward + in-place optimizer update (the body of
+    :meth:`~.dist_embedding.DistributedEmbedding.sparse_apply_gradients`;
+    see that method's docstring for the full argument contract).
+
+    Routes the output cotangents through the reverse all-to-all
+    (:mod:`.exchange`), rebuilds the per-group id streams from the
+    forward residual (:mod:`.lookup`'s ragged machinery), and applies
+    per-row scatter updates via :func:`apply_width_streams` — never
+    materializing dense table gradients. This is the IndexedSlices
+    pipeline of the reference (``dist_model_parallel.py:526-567`` + the
+    grad kernel) in SPMD form."""
+    params = de.local_view(params)
+    if isinstance(opt_state, dict):
+        opt_state = de.local_view(opt_state)
+    if scale is None:
+        scale = 1.0 / de.world_size
+
+    _, ids_recv, encs, b = residuals
+    # single-worker no-combiner outputs keep their [b, h, w] rank
+    # (reference call semantics); the exchange layout is flat columns
+    out_grads = [g.reshape(g.shape[0], -1) for g in out_grads]
+    world = de.world_size
+    plan = de._get_plan(list(encs), b)
+
+    # Invert the column-slice collapse then the input-order reorder,
+    # rebuilding worker order. In fully-expanded coordinates, output entry
+    # e has width worker_widths[rev[e]]; input i owns the next
+    # slices-per-table[table(i)] expanded entries.
+    worker_widths = [plan.out_width(inst) for inst in plan.instances]
+    rev = de.strategy.rev_global_input_ids
+    expanded: List[Optional[jax.Array]] = []
+    e = 0
+    for i, g in enumerate(out_grads):
+        tid = de.strategy.input_table_map[i]
+        k = de._slices_per_table[tid]
+        if k == 1:
+            expanded.append(g)
+        elif tid in de.strategy.row_sliced_tables:
+            # output was the SUM of row slices, so every slice's
+            # cotangent is the full g (its own out-of-range rows drop)
+            expanded.extend([g] * k)
+        else:
+            pos = 0
+            for s in range(k):
+                w = worker_widths[rev[e + s]]
+                expanded.append(lax.slice(g, (0, pos), (b, pos + w)))
+                pos += w
+        e += k
+    worker_grads: List[Optional[jax.Array]] = [None] * len(rev)
+    for idx, g in enumerate(expanded):
+        worker_grads[rev[idx]] = g
+
+    # Pack [world, b, s_max] in the plan's column layout and reverse the
+    # output all-to-all (autodiff of the forward exchange would insert the
+    # same collective; reference rides Horovod's registered alltoall grad).
+    out_dtype = (out_grads[0].dtype if out_grads
+                 else next(iter(params.values())).dtype)
+    grads_by_worker = dict(zip(plan.instances, worker_grads))
+    packed = exchange_mod.pack_grad_blocks(de, plan, grads_by_worker, b,
+                                           out_dtype)
+    mp_grad = exchange_mod.exchange_grads(de, packed)
+
+    # Rank-uniform sparse update: per group, rebuild the id stream from
+    # the forward's residual block and expand slot cotangents to per-id
+    # update rows; per width, one optimizer scatter.
+    my = de._my_rank()
+    per_width: Dict[str, List] = {}
+    for gi, g in enumerate(plan.groups):
+        rows = de._plan_row(plan.rows[gi], my)
+        roff = de._plan_row(plan.roff[gi], my)
+        any_mean = bool(plan.mean[gi].any())
+        all_mean = bool(plan.mean[gi].all())
+        all_valid = bool((plan.valid[gi] > 0).all())
+        valid = (None if all_valid
+                 else de._plan_row(plan.valid[gi], my))
+        rbase = (de._plan_row(plan.rbase[gi], my)
+                 if plan.rsliced[gi].any() else None)
+        sent = de.rows_cap[g.width]  # dropped-row sentinel (logical)
+        region = lax.slice(ids_recv, (0, g.goff),
+                           (world, g.goff + g.n * g.blen))
+        gsl = lax.slice(mp_grad, (0, 0, g.col),
+                        (world, b, g.col + g.n * g.width))
+        gsl = gsl.reshape(world, b, g.n, g.width)
+        if g.kind == "d":
+            # b-major stream: the value rows are then exactly the
+            # [world, b, n, w] grad layout — a FREE reshape of the
+            # exchange row instead of a materialized transpose (the
+            # [b, n*w] -> [n, b, w] copy + cast measured ~26 ms at the
+            # DLRM headline shapes); only the small int id tensor
+            # transposes. The optimizer sorts the stream anyway, so
+            # stream order is free to choose (docs/perf_tpu.md r4).
+            ids4 = region.reshape(world, g.n, b, g.hot
+                                  ).transpose(0, 2, 1, 3)
+            if rbase is not None:  # row-sliced slots: range-local ids
+                ids4 = ids4 - rbase[None, None, :, None]
+            # out-of-range ids were clipped in the forward (safety net)
+            # but are dropped here: a bad id trains nothing (see the
+            # dist_embedding module docstring contract)
+            ok = (ids4 >= 0) & (ids4 < rows[None, None, :, None])
+            if valid is not None:
+                ok = ok & (valid[None, None, :, None] > 0)
+            ids = jnp.where(ok, ids4 + roff[None, None, :, None], sent)
+            gb = gsl
+            if g.hot > 1 and any_mean:
+                if all_mean:
+                    gb = gsl / g.hot
+                else:
+                    mean = de._plan_row(plan.mean[gi], my)
+                    gb = jnp.where(mean[None, None, :, None] > 0,
+                                   gsl / g.hot, gsl)
+            vals = jnp.broadcast_to(
+                gb[:, :, :, None, :],
+                (world, b, g.n, g.hot, g.width))
+        else:
+            gsl = gsl.transpose(0, 2, 1, 3)  # ragged sidx layout is
+            # (source, slot, row): one small copy, the take absorbs it
+            values, _, seg, _, counts = lookup_mod.ragged_decode(
+                de, g, b, region, rows, roff, valid,
+                need_counts=any_mean, rbase=rbase)
+            if rbase is not None:  # row-sliced slots: range-local ids
+                values = values - rbase[None, :, None]
+            sidx = lookup_mod.ragged_scatter_idx(g, b, world, seg)
+            gpad = jnp.concatenate(
+                [gsl, de._vary(jnp.zeros((world, g.n, 1, g.width),
+                                         gsl.dtype))],
+                axis=2)  # [world, n, b+1, w]
+            vals = jnp.take(gpad.reshape(-1, g.width), sidx.reshape(-1),
+                            axis=0).reshape(world, g.n, g.hot, g.width)
+            if g.kind == "rw":
+                # d(w_i * x_i)/dx_i: the weight multiplies the per-id
+                # cotangent (the reference backward reuses the forward
+                # kernel with the same weights input, .cu:539-627)
+                wts = lookup_mod.region_weights(de, g, b, region)
+                vals = vals * wts[..., None].astype(vals.dtype)
+            if any_mean:
+                cpad = jnp.concatenate(
+                    [counts, jnp.ones((world, g.n, 1), counts.dtype)],
+                    axis=2)
+                cval = jnp.take(cpad.reshape(-1), sidx.reshape(-1)
+                                ).reshape(world, g.n, g.hot)
+                div = vals / cval[..., None].astype(vals.dtype)
+                if all_mean:
+                    vals = div
+                else:
+                    mean = de._plan_row(plan.mean[gi], my)
+                    vals = jnp.where(mean[None, :, None, None] > 0,
+                                     div, vals)
+            ok = (seg < b) & (values >= 0) & (values < rows[None, :, None])
+            if valid is not None:
+                ok = ok & (valid[None, :, None] > 0)
+            ids = jnp.where(ok, values + roff[None, :, None], sent)
+        per_width.setdefault(_wkey(g.width), []).append(
+            (ids, vals, g.width))
+
+    return apply_width_streams(de, params, opt_state, per_width,
+                               optimizer, lr, scale, enable=enable)

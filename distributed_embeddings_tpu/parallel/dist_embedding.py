@@ -48,6 +48,16 @@ neighbouring table in the slab) but routed to the dropped sentinel in the
 sparse backward, so a clipped id trains nothing: don't rely on the clip. Ragged features travel inside the padded id all-to-all as
 ``[values(cap), lengths(b)]`` blocks — the variable-hotness capability the
 reference reaches through its custom kernel (``embedding_lookup_ops.py:79-80``).
+
+**Module layout.** This file is the orchestrator: parameter/layout
+ownership, input normalization, checkpointing, metrics, telemetry, and
+streaming. The step's executor phases live in three sibling modules the
+:class:`~.schedule.StepSchedule` names — :mod:`.exchange` (block
+assembly + the three all-to-alls), :mod:`.lookup` (plan-driven gathers
+and combiners), and :mod:`.apply` (the manual sparse backward + the
+per-width optimizer scatters). The split is pure code motion from the
+former monolith: the traced step — and therefore the compiled HLO, the
+census pass budgets, and the trajectory CRCs — is bit-for-bit unchanged.
 """
 
 from __future__ import annotations
@@ -66,10 +76,13 @@ from .. import compat
 from ..utils import obs
 from ..utils import runtime as _runtime
 from ..layers.embedding import default_embeddings_init
-from ..ops.embedding_lookup import (Ragged, SparseIds, ragged_row_ids,
-                                    row_to_split)
+from ..ops.embedding_lookup import Ragged, SparseIds, row_to_split
 from ..ops import packed_slab as ps
+from . import apply as apply_mod
+from . import exchange as exchange_mod
+from . import lookup as lookup_mod
 from . import plan as plan_mod
+from .schedule import default_schedule
 from .strategy import DistEmbeddingStrategy
 
 EmbedParams = Dict[str, jax.Array]
@@ -114,11 +127,6 @@ class MpInputs:
     packed: jax.Array
     hots: tuple = struct.field(pytree_node=False)
     local_batch: int = struct.field(pytree_node=False)
-
-
-# Marks exchange-layout cells covered by a multi-cell content array placed at
-# an earlier slot (no-combiner multi-hot features span `hotness` slots).
-_SPANNED = object()
 
 
 def _wkey(width: int) -> str:
@@ -334,6 +342,13 @@ class DistributedEmbedding:
                          for w in widths}
         # exchange plans are (input signature, batch)-dependent; built lazily
         self._plan_cache: Dict[tuple, plan_mod.ExchangePlan] = {}
+        # the explicit step schedule the orchestrator runs and the
+        # schedule auditor certifies (parallel/schedule.py): phase names,
+        # declared ordering, declared overlap. Today's default is the
+        # honest serialized baseline — every collective declares
+        # overlaps=() — which tools/schedule_audit.py verifies against
+        # the compiled program's dependency DAG.
+        self.schedule = default_schedule()
 
     # ------------------------------------------------------------------ params
 
@@ -693,23 +708,6 @@ class DistributedEmbedding:
                            else inp[:, None])
         return out, encs, shapes
 
-    @staticmethod
-    def _csr_seg(lengths, cap: int):
-        """CSR offsets and per-position segment ids from per-row lengths,
-        for any leading batch dims: ``lengths [..., b]`` ->
-        ``(splits [..., b+1], seg [..., cap])`` with positions past each
-        CSR's total mapped to ``b``. The one derivation every ragged path
-        shares (the reference's ``RowToSplit``/``OffsetToWeightsAndRowId``
-        pair, ``embedding_lookup_kernels.cu:331-361``)."""
-        lead = lengths.shape[:-1]
-        b = lengths.shape[-1]
-        flat = lengths.reshape(-1, b)
-        zero = jnp.zeros((flat.shape[0], 1), flat.dtype)
-        splits = jnp.concatenate([zero, jnp.cumsum(flat, axis=1)], axis=1)
-        seg = jax.vmap(functools.partial(ragged_row_ids, capacity=cap))(
-            splits)
-        return splits.reshape(*lead, b + 1), seg.reshape(*lead, cap)
-
     def pack_mp_inputs(self, inputs, dtype=None, mesh=None,
                        hots: Optional[Sequence[Any]] = None,
                        local_batch: Optional[int] = None,
@@ -952,14 +950,16 @@ class DistributedEmbedding:
             comm_dtype = (entries[0][1].dtype if isinstance(entries[0], tuple)
                           else entries[0].dtype)
             plan = self._get_plan(encs, b)
-            ids_recv = self._build_send_blocks(plan, entries, comm_dtype)
+            ids_recv = exchange_mod.build_send_blocks(self, plan, entries,
+                                                      comm_dtype)
             ids_recv, spending = self._streaming_remap(plan, ids_recv,
                                                        streaming)
             # slot-major group outputs: per-instance outputs are plain
             # slices, skipping the exchange-row transpose the single
             # worker never needs (only multi-slot instances pay a small
             # per-instance transpose)
-            reds = self._plan_lookup_groups(plan, params, ids_recv)
+            reds = lookup_mod.plan_lookup_groups(self, plan, params,
+                                                 ids_recv)
             outs = []
             for inst in plan.instances:  # worker order == input order here
                 g = plan.groups[inst.group]
@@ -1005,15 +1005,10 @@ class DistributedEmbedding:
                           else entries[0].dtype)
             plan = self._get_plan(encs, b)
 
-            # --- dp -> mp id exchange --------------------------------------
-            # Blocks use the rank-uniform group-region layout (plan.py); the
-            # reference pads to the max per-rank split instead
-            # (dist_model_parallel.py:273-282) — same idea, but static
-            # regions let the lookup below run without per-rank branches.
-            with obs.scope("id_all_to_all"):
-                ids_send = self._build_send_blocks(plan, entries, comm_dtype)
-                ids_recv = lax.all_to_all(ids_send, self.axis_name, 0, 0,
-                                          tiled=True)
+            # --- dp -> mp id exchange (schedule phase "id_all_to_all",
+            # parallel/exchange.py) -----------------------------------------
+            ids_recv = exchange_mod.exchange_ids(self, plan, entries,
+                                                 comm_dtype)
         else:
             # --- model-parallel input: this rank already holds the global
             # batch of ids for its local tables; no id exchange runs
@@ -1044,12 +1039,14 @@ class DistributedEmbedding:
         # --- streaming remap (dynamic-vocab tables) ------------------------
         ids_recv, spending = self._streaming_remap(plan, ids_recv, streaming)
 
-        # --- rank-uniform local lookup (plan-tensor-driven) ----------------
-        mp_out = self._plan_lookup(plan, params, ids_recv)  # [world, b, s_max]
+        # --- rank-uniform local lookup (schedule phase family
+        # "lookup_*", parallel/lookup.py) -----------------------------------
+        mp_out = lookup_mod.plan_lookup(self, plan, params,
+                                        ids_recv)  # [world, b, s_max]
 
-        # --- mp -> dp output exchange --------------------------------------
-        with obs.scope("out_all_to_all"):
-            dp_recv = lax.all_to_all(mp_out, self.axis_name, 0, 0, tiled=True)
+        # --- mp -> dp output exchange (schedule phase "out_all_to_all",
+        # parallel/exchange.py) ---------------------------------------------
+        dp_recv = exchange_mod.exchange_outputs(self, mp_out)
         # dp_recv[r] = this rank's batch as computed by source rank r.
 
         # --- unpack (static slices), reorder, concat column slices ---------
@@ -1110,294 +1107,7 @@ class DistributedEmbedding:
         c = self._vary(jnp.asarray(arr))
         return lax.dynamic_index_in_dim(c, my, keepdims=False)
 
-    def _assemble_cells(self, plan, fill, dead_shape, full_shape, dtype,
-                        axis: int) -> jax.Array:
-        """Shared layout assembly for the forward id blocks and backward grad
-        blocks: place each instance's content at its (rank, group, slot0)
-        cell — content spans all ``num_slots`` cells of a multi-slot
-        instance — fill dead cells with zeros, concatenate in group/slot
-        layout order per destination rank, and stack over ranks.
-
-        Args:
-          fill: ``fill(inst) -> array`` — the instance's content in layout
-            form (ids flattened / grad block).
-          dead_shape: ``dead_shape(group) -> shape`` of one dead cell.
-          full_shape: shape of an all-dead destination row (no-groups edge).
-          dtype: content dtype (zeros match it).
-          axis: concat axis of the per-destination parts.
-        """
-        cells = [[[None] * g.n for g in plan.groups]
-                 for _ in range(self.world_size)]
-        for inst in plan.instances:
-            row = cells[inst.rank][inst.group]
-            row[inst.slot0] = fill(inst)
-            for k in range(1, inst.num_slots):
-                row[inst.slot0 + k] = _SPANNED
-        zeros_cache: Dict[tuple, jax.Array] = {}
-
-        def dead(shape):
-            z = zeros_cache.get(shape)
-            if z is None:
-                z = self._vary(jnp.zeros(shape, dtype))
-                zeros_cache[shape] = z
-            return z
-
-        blocks = []
-        for dest in range(self.world_size):
-            parts = []
-            for gi, g in enumerate(plan.groups):
-                for k in range(g.n):
-                    c = cells[dest][gi][k]
-                    if c is _SPANNED:
-                        continue
-                    parts.append(dead(dead_shape(g)) if c is None else c)
-            blocks.append(jnp.concatenate(parts, axis=axis) if parts
-                          else dead(full_shape))
-        return jnp.stack(blocks)
-
-    def _build_send_blocks(self, plan, entries, comm_dtype) -> jax.Array:
-        """Assemble the dp->mp id blocks ``[world, l_max]`` in the plan's
-        group-region layout. Dead (padding) slots send zeros; a multi-slot
-        feature (no-combiner multi-hot, or N-D dense) sends its ids
-        slot-major so each slot's ids stay contiguous."""
-
-        def fill(inst):
-            e = entries[inst.input_id]
-            if isinstance(e, tuple):  # ("r"|"rw", values, lengths[, wbits])
-                parts = [e[1].astype(comm_dtype), e[2].astype(comm_dtype)]
-                if e[0] == "rw":
-                    parts.append(e[3].astype(comm_dtype))
-                return jnp.concatenate(parts)
-            if inst.transposed:  # slot-major: [b, ns*h] -> [ns, b, h] flat
-                h = plan.groups[inst.group].hot
-                return e.reshape(e.shape[0], inst.num_slots, h
-                                 ).transpose(1, 0, 2).reshape(-1)
-            return e.reshape(-1)
-
-        return self._assemble_cells(
-            plan, fill, dead_shape=lambda g: (g.blen,),
-            full_shape=(plan.l_max,), dtype=comm_dtype, axis=0)
-
-    def _ragged_decode(self, g, b: int, region, rows, roff, valid,
-                       need_counts: bool = True, rbase=None):
-        """Decode one ragged group region ``[world, n*(cap+b)]`` into
-        ``(values, lengths, seg, grow, counts)``, all ``[world, n, ...]``.
-        Dead slots get zero lengths, so every position routes to the dropped
-        segment ``b``. ``valid=None`` means every slot is statically live
-        (skips the mask multiply); ``need_counts=False`` skips the
-        mean-divisor counts (sum-only groups never read them); ``rbase``
-        (row-sliced slots) is subtracted from the raw values before the
-        clip — ``values`` stays raw so callers mask consistently."""
-        world = self.world_size
-        with obs.scope("ragged_decode"):
-            r3 = region.reshape(world, g.n, g.blen)
-            values = r3[:, :, :g.hot]
-            lengths = r3[:, :, g.hot:g.hot + b]  # "rw" blocks carry weight
-            # bits past the lengths (decoded by _region_weights)
-            if valid is not None:
-                lengths = lengths * valid[None, :, None].astype(r3.dtype)
-            _, seg = self._csr_seg(lengths, g.hot)
-            loc = (values - rbase[None, :, None] if rbase is not None
-                   else values)
-            grow = (jnp.clip(loc, 0, (rows - 1)[None, :, None])
-                    + roff[None, :, None])
-            counts = jnp.maximum(lengths, 1) if need_counts else None
-            return values, lengths, seg, grow, counts
-
-    def _region_weights(self, g, b: int, region) -> jax.Array:
-        """Decode a weighted-ragged ("rw") region's per-id weights
-        ``[world, n, cap]`` from the bitcast payload past the lengths."""
-        world = self.world_size
-        r3 = region.reshape(world, g.n, g.blen)
-        bits = r3[:, :, g.hot + b:].astype(jnp.int32)
-        return lax.bitcast_convert_type(bits, jnp.float32)
-
-    @staticmethod
-    def _ragged_scatter_idx(g, b: int, world: int, seg) -> jax.Array:
-        """Flattened per-value output index into a ``[world*n*(b+1), w]``
-        segment buffer; row ``b`` of each slot is the dropped sentinel."""
-        s_ix = jnp.arange(world, dtype=seg.dtype)[:, None, None]
-        f_ix = jnp.arange(g.n, dtype=seg.dtype)[None, :, None]
-        return (s_ix * g.n + f_ix) * (b + 1) + seg
-
-    def _plan_lookup(self, plan, params: EmbedParams, ids_recv) -> jax.Array:
-        """All local lookups in exchange-row layout ``[world, b, s_max]``
-        (``compute_dtype`` — the pre-comm mixed-precision cast, reference
-        ``dist_model_parallel.py:300``). Dead slots produce garbage columns
-        that no consumer ever slices."""
-        world = self.world_size
-        b = plan.b
-        # _plan_lookup_groups already casts to compute_dtype; only the
-        # no-groups zeros fallback needs the explicit dtype
-        zdt = (self.compute_dtype
-               or next(iter(params.values())).dtype)
-        sections = [
-            red.transpose(0, 2, 1, 3).reshape(world, b, -1)
-            for red in self._plan_lookup_groups(plan, params, ids_recv)]
-        return (jnp.concatenate(sections, axis=2) if sections
-                else self._vary(jnp.zeros((world, b, plan.s_max), zdt)))
-
-    def _plan_lookup_groups(self, plan, params: EmbedParams,
-                            ids_recv) -> List[jax.Array]:
-        """Per-group combined lookups in slot-major ``[world, n, b, width]``
-        layout: one region reshape, one slab gather, one combine per group.
-        The single-worker forward consumes these directly (its per-instance
-        outputs are plain slot slices), skipping the ``[world, b, s_max]``
-        exchange-row transpose that only the all-to-all needs — the dense
-        model re-stacks outputs feature-major anyway, so the transpose
-        round trip was a pure extra pass at headline shapes."""
-        my = self._my_rank()
-        sections = []
-        for gi, g in enumerate(plan.groups):
-            # one named scope per (width, kind) group: a profile of the
-            # step attributes gather/combine time to the width it serves
-            with obs.scope(f"lookup_w{g.width}_{g.kind}"):
-                red = self._lookup_group(plan, gi, g, params[_wkey(g.width)],
-                                         ids_recv, my, plan.b)
-            dt = self.compute_dtype
-            sections.append(red.astype(dt) if dt is not None else red)
-        return sections
-
-    def _lookup_group(self, plan, gi: int, g, slab, ids_recv, my,
-                      b: int) -> jax.Array:
-        """One exchange group's combined lookup in slot-major
-        ``[world, n, b, width]`` layout (the body of
-        :meth:`_plan_lookup_groups`, split out so each group runs under its
-        own named scope)."""
-        world = self.world_size
-        rows = self._plan_row(plan.rows[gi], my)
-        roff = self._plan_row(plan.roff[gi], my)
-        # mean/valid are *static* plan tensors: when no slot on any rank
-        # is a mean combiner (resp. dead), the divide (resp. mask) is
-        # skipped at trace time — sum-only groups never touch counts
-        any_mean = bool(plan.mean[gi].any())
-        all_mean = bool(plan.mean[gi].all())
-        all_valid = bool((plan.valid[gi] > 0).all())
-        # row-sliced slots subtract their range base and must read zero
-        # outside the range (their outputs SUM across slices); the same
-        # mask doubles as the opt-in masked_reads debug contract. The
-        # mask is gated PER SLOT (plan.rsliced): an unsliced table that
-        # shares the exchange group keeps the documented
-        # clip-to-last-row read unless masked_reads=True.
-        any_rslice = bool(plan.rsliced[gi].any())
-        use_mask = any_rslice or self.masked_reads
-        rbase = (self._plan_row(plan.rbase[gi], my) if any_rslice
-                 else None)
-        region = lax.slice(ids_recv, (0, g.goff),
-                           (world, g.goff + g.n * g.blen))
-        if g.kind == "d":
-            ids = region.reshape(world, g.n, b, g.hot)
-            if rbase is not None:
-                ids = ids - rbase[None, :, None, None]
-            grow = (jnp.clip(ids, 0, (rows - 1)[None, :, None, None])
-                    + roff[None, :, None, None])
-            gath = ps.packed_gather(slab, grow, g.width)
-            if use_mask:
-                inr = ((ids >= 0) & (ids < rows[None, :, None, None]))
-                if not self.masked_reads:  # only sliced slots mask
-                    rsl = self._plan_row(plan.rsliced[gi], my)
-                    inr = inr | (rsl[None, :, None, None] == 0)
-                gath = gath * inr[..., None].astype(gath.dtype)
-            red = jnp.sum(gath, axis=3)  # [world, n, b, w]
-            if g.hot > 1 and any_mean:
-                if all_mean:
-                    red = red / g.hot
-                else:
-                    mean = self._plan_row(plan.mean[gi], my)
-                    red = jnp.where(mean[None, :, None, None] > 0,
-                                    red / g.hot, red)
-        else:
-            values, _, seg, grow, counts = self._ragged_decode(
-                g, b, region, rows, roff,
-                None if all_valid else self._plan_row(plan.valid[gi], my),
-                need_counts=any_mean, rbase=rbase)
-            gath = ps.packed_gather(slab, grow, g.width)  # [w, n, cap, ww]
-            if g.kind == "rw":
-                # per-id weights multiply the gathered rows (reference
-                # kernel's optional weights, .cu:52-55); mean still
-                # divides by the id count (.cu:220-222)
-                wts = self._region_weights(g, b, region)
-                gath = gath * wts[..., None].astype(gath.dtype)
-            if use_mask:
-                loc = (values - rbase[None, :, None]
-                       if rbase is not None else values)
-                inr = ((loc >= 0) & (loc < rows[None, :, None]))
-                if not self.masked_reads:  # only sliced slots mask
-                    rsl = self._plan_row(plan.rsliced[gi], my)
-                    inr = inr | (rsl[None, :, None] == 0)
-                gath = gath * inr[..., None].astype(gath.dtype)
-            sidx = self._ragged_scatter_idx(g, b, world, seg)
-            buf = jnp.zeros((world * g.n * (b + 1), g.width), gath.dtype)
-            # sidx ascends globally: (source, slot) blocks are laid out
-            # ascending and seg ascends within each CSR block
-            buf = buf.at[sidx.reshape(-1)].add(
-                gath.reshape(-1, g.width), indices_are_sorted=True)
-            red = buf.reshape(world, g.n, b + 1, g.width)[:, :, :b, :]
-            if any_mean:
-                div = red / counts[..., None].astype(red.dtype)
-                if all_mean:
-                    red = div
-                else:
-                    mean = self._plan_row(plan.mean[gi], my)
-                    red = jnp.where(mean[None, :, None, None] > 0,
-                                    div, red)
-        return red
-
     # ------------------------------------------------------ sparse backward
-
-    def _apply_width_streams(self, params: EmbedParams, opt_state,
-                             per_width: Dict[str, List], optimizer, lr,
-                             scale, enable=None):
-        """Concatenate each width's (logical ids, update rows) stream,
-        lane-expand to physical full-tile rows, and run ONE optimizer scatter
-        per width slab. Stateful-moment optimizers additionally receive the
-        lane touch-mask (``ops/packed_slab.py:expand_touch_mask``) so packed
-        neighbour rows keep their state.
-
-        ``enable`` (scalar bool, traced): when False every update row is
-        routed to the dropped sentinel — the scatters drop out of bounds,
-        so the slabs AND every slab-shaped optimizer state component stay
-        bitwise-unchanged. This is the non-finite guard's skip path: an
-        O(ids) mask instead of a slab-wide select (which would read+write
-        gigabytes of tables per step just to discard the result)."""
-        new_params = dict(params)
-        new_state = dict(opt_state) if isinstance(opt_state, dict) else opt_state
-        wants_mask = getattr(optimizer, "needs_touch_mask", False)
-        for k in sorted(per_width):
-            with obs.scope(f"sparse_apply_{k}"):
-                tris = per_width[k]
-                w = tris[0][2]
-                ids = jnp.concatenate([t[0].reshape(-1) for t in tris])
-                if enable is not None:
-                    # disabled step: all rows -> logical sentinel (the same
-                    # dropped-row id the backward uses for OOB ids)
-                    ids = jnp.where(enable, ids,
-                                    jnp.asarray(self.rows_cap[w], ids.dtype))
-                vals = jnp.concatenate(
-                    [t[1].reshape(-1, w) for t in tris]) * scale
-                # lane-expand to physical rows: the scatter (and any dedup
-                # in the optimizer) runs on full-tile rows; lane-disjoint
-                # placement keeps per-logical-row semantics exact
-                # (ops/packed_slab.py)
-                phys_ids, pvals = ps.expand_update_rows(vals, ids, w)
-                kw = {}
-                if wants_mask:
-                    # compact [n, p] lane mask rides the optimizer's dedup
-                    # and expands to lanes after
-                    # (ops/packed_slab.py:lane_one_hot)
-                    m = ps.lane_one_hot(ids, w, dtype=pvals.dtype)
-                    if m is not None:
-                        kw["mask"] = m
-                        kw["lane_width"] = w
-                slab = new_params[k]
-                st = (new_state[k] if isinstance(new_state, dict)
-                      else new_state)
-                slab, st = optimizer.apply_rows(slab, st, phys_ids, pvals,
-                                                lr, **kw)
-                new_params[k] = slab
-                if isinstance(new_state, dict):
-                    new_state[k] = st
-        return new_params, new_state
 
     def sparse_apply_gradients(self, params: EmbedParams, opt_state, residuals,
                                out_grads, optimizer, lr, scale=None,
@@ -1426,165 +1136,16 @@ class DistributedEmbedding:
           enable: optional traced scalar bool — when False the whole update
             is skipped with slabs and slab-shaped optimizer state bitwise
             unchanged (every update row routes to the dropped sentinel; see
-            :meth:`_apply_width_streams`). The trainer's non-finite guard
+            :func:`~.apply.apply_width_streams`). The trainer's non-finite
+            guard
             passes its finiteness verdict here.
 
         Returns:
           ``(new_params, new_opt_state)``.
         """
-        params = self.local_view(params)
-        if isinstance(opt_state, dict):
-            opt_state = self.local_view(opt_state)
-        if scale is None:
-            scale = 1.0 / self.world_size
-
-        _, ids_recv, encs, b = residuals
-        # single-worker no-combiner outputs keep their [b, h, w] rank
-        # (reference call semantics); the exchange layout is flat columns
-        out_grads = [g.reshape(g.shape[0], -1) for g in out_grads]
-        world = self.world_size
-        plan = self._get_plan(list(encs), b)
-
-        # Invert the column-slice collapse then the input-order reorder,
-        # rebuilding worker order. In fully-expanded coordinates, output entry
-        # e has width worker_widths[rev[e]]; input i owns the next
-        # slices-per-table[table(i)] expanded entries.
-        worker_widths = [plan.out_width(inst) for inst in plan.instances]
-        rev = self.strategy.rev_global_input_ids
-        expanded: List[Optional[jax.Array]] = []
-        e = 0
-        for i, g in enumerate(out_grads):
-            tid = self.strategy.input_table_map[i]
-            k = self._slices_per_table[tid]
-            if k == 1:
-                expanded.append(g)
-            elif tid in self.strategy.row_sliced_tables:
-                # output was the SUM of row slices, so every slice's
-                # cotangent is the full g (its own out-of-range rows drop)
-                expanded.extend([g] * k)
-            else:
-                pos = 0
-                for s in range(k):
-                    w = worker_widths[rev[e + s]]
-                    expanded.append(lax.slice(g, (0, pos), (b, pos + w)))
-                    pos += w
-            e += k
-        worker_grads: List[Optional[jax.Array]] = [None] * len(rev)
-        for idx, g in enumerate(expanded):
-            worker_grads[rev[idx]] = g
-
-        # Pack [world, b, s_max] in the plan's column layout and reverse the
-        # output all-to-all (autodiff of the forward exchange would insert the
-        # same collective; reference rides Horovod's registered alltoall grad).
-        out_dtype = (out_grads[0].dtype if out_grads
-                     else next(iter(params.values())).dtype)
-        grads_by_worker = dict(zip(plan.instances, worker_grads))
-        packed = self._assemble_cells(
-            plan,
-            # a multi-slot instance's grad [b, num_slots*w] spans its columns
-            fill=lambda inst: grads_by_worker[inst].astype(out_dtype),
-            dead_shape=lambda g: (b, g.width),
-            full_shape=(b, plan.s_max), dtype=out_dtype,
-            axis=1)  # [world, b, s_max]
-        with obs.scope("grad_all_to_all"):
-            mp_grad = (lax.all_to_all(packed, self.axis_name, 0, 0,
-                                      tiled=True)
-                       if world > 1 else packed)
-
-        # Rank-uniform sparse update: per group, rebuild the id stream from
-        # the forward's residual block and expand slot cotangents to per-id
-        # update rows; per width, one optimizer scatter.
-        my = self._my_rank()
-        per_width: Dict[str, List] = {}
-        for gi, g in enumerate(plan.groups):
-            rows = self._plan_row(plan.rows[gi], my)
-            roff = self._plan_row(plan.roff[gi], my)
-            any_mean = bool(plan.mean[gi].any())
-            all_mean = bool(plan.mean[gi].all())
-            all_valid = bool((plan.valid[gi] > 0).all())
-            valid = (None if all_valid
-                     else self._plan_row(plan.valid[gi], my))
-            rbase = (self._plan_row(plan.rbase[gi], my)
-                     if plan.rsliced[gi].any() else None)
-            sent = self.rows_cap[g.width]  # dropped-row sentinel (logical)
-            region = lax.slice(ids_recv, (0, g.goff),
-                               (world, g.goff + g.n * g.blen))
-            gsl = lax.slice(mp_grad, (0, 0, g.col),
-                            (world, b, g.col + g.n * g.width))
-            gsl = gsl.reshape(world, b, g.n, g.width)
-            if g.kind == "d":
-                # b-major stream: the value rows are then exactly the
-                # [world, b, n, w] grad layout — a FREE reshape of the
-                # exchange row instead of a materialized transpose (the
-                # [b, n*w] -> [n, b, w] copy + cast measured ~26 ms at the
-                # DLRM headline shapes); only the small int id tensor
-                # transposes. The optimizer sorts the stream anyway, so
-                # stream order is free to choose (docs/perf_tpu.md r4).
-                ids4 = region.reshape(world, g.n, b, g.hot
-                                      ).transpose(0, 2, 1, 3)
-                if rbase is not None:  # row-sliced slots: range-local ids
-                    ids4 = ids4 - rbase[None, None, :, None]
-                # out-of-range ids were clipped in the forward (safety net)
-                # but are dropped here: a bad id trains nothing (see module
-                # docstring contract)
-                ok = (ids4 >= 0) & (ids4 < rows[None, None, :, None])
-                if valid is not None:
-                    ok = ok & (valid[None, None, :, None] > 0)
-                ids = jnp.where(ok, ids4 + roff[None, None, :, None], sent)
-                gb = gsl
-                if g.hot > 1 and any_mean:
-                    if all_mean:
-                        gb = gsl / g.hot
-                    else:
-                        mean = self._plan_row(plan.mean[gi], my)
-                        gb = jnp.where(mean[None, None, :, None] > 0,
-                                       gsl / g.hot, gsl)
-                vals = jnp.broadcast_to(
-                    gb[:, :, :, None, :],
-                    (world, b, g.n, g.hot, g.width))
-            else:
-                gsl = gsl.transpose(0, 2, 1, 3)  # ragged sidx layout is
-                # (source, slot, row): one small copy, the take absorbs it
-                values, _, seg, _, counts = self._ragged_decode(
-                    g, b, region, rows, roff, valid,
-                    need_counts=any_mean, rbase=rbase)
-                if rbase is not None:  # row-sliced slots: range-local ids
-                    values = values - rbase[None, :, None]
-                sidx = self._ragged_scatter_idx(g, b, world, seg)
-                gpad = jnp.concatenate(
-                    [gsl, self._vary(jnp.zeros((world, g.n, 1, g.width),
-                                               gsl.dtype))],
-                    axis=2)  # [world, n, b+1, w]
-                vals = jnp.take(gpad.reshape(-1, g.width), sidx.reshape(-1),
-                                axis=0).reshape(world, g.n, g.hot, g.width)
-                if g.kind == "rw":
-                    # d(w_i * x_i)/dx_i: the weight multiplies the per-id
-                    # cotangent (the reference backward reuses the forward
-                    # kernel with the same weights input, .cu:539-627)
-                    wts = self._region_weights(g, b, region)
-                    vals = vals * wts[..., None].astype(vals.dtype)
-                if any_mean:
-                    cpad = jnp.concatenate(
-                        [counts, jnp.ones((world, g.n, 1), counts.dtype)],
-                        axis=2)
-                    cval = jnp.take(cpad.reshape(-1), sidx.reshape(-1)
-                                    ).reshape(world, g.n, g.hot)
-                    div = vals / cval[..., None].astype(vals.dtype)
-                    if all_mean:
-                        vals = div
-                    else:
-                        mean = self._plan_row(plan.mean[gi], my)
-                        vals = jnp.where(mean[None, :, None, None] > 0,
-                                         div, vals)
-                ok = (seg < b) & (values >= 0) & (values < rows[None, :, None])
-                if valid is not None:
-                    ok = ok & (valid[None, :, None] > 0)
-                ids = jnp.where(ok, values + roff[None, :, None], sent)
-            per_width.setdefault(_wkey(g.width), []).append(
-                (ids, vals, g.width))
-
-        return self._apply_width_streams(params, opt_state, per_width,
-                                         optimizer, lr, scale, enable=enable)
+        return apply_mod.sparse_apply_gradients(
+            self, params, opt_state, residuals, out_grads, optimizer,
+            lr, scale=scale, enable=enable)
 
     # --------------------------------------------------------- observability
 

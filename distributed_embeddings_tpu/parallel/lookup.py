@@ -1,0 +1,221 @@
+"""Lookup layer of the hybrid step: plan-driven gathers and combiners.
+
+One of the three executor modules the ``dist_embedding.py`` monolith
+split into (:mod:`.exchange` / lookup / :mod:`.apply`). This module owns
+everything between the two forward exchanges: decoding the received
+group regions, the per-(width, kind) slab gathers, combiner reductions,
+and the shared ragged CSR machinery the backward reuses.
+
+Each (width, kind) group runs under its own ``obs.scope`` in the
+:data:`~.schedule.PHASE_LOOKUP` phase family (``lookup_w{w}_{kind}``),
+so profiles, the HLO census, and the schedule auditor attribute
+gather/combine cost to the width it serves.
+
+Every function takes the owning
+:class:`~.dist_embedding.DistributedEmbedding` as its first argument
+(except the pure shape helpers); the split is pure code motion — the
+traced program is bit-for-bit what the monolith's methods produced.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils import obs
+from ..ops.embedding_lookup import ragged_row_ids
+from ..ops import packed_slab as ps
+
+
+def _wkey(width: int) -> str:
+    return f"w{width}"
+
+
+def csr_seg(lengths, cap: int):
+    """CSR offsets and per-position segment ids from per-row lengths,
+    for any leading batch dims: ``lengths [..., b]`` ->
+    ``(splits [..., b+1], seg [..., cap])`` with positions past each
+    CSR's total mapped to ``b``. The one derivation every ragged path
+    shares (the reference's ``RowToSplit``/``OffsetToWeightsAndRowId``
+    pair, ``embedding_lookup_kernels.cu:331-361``)."""
+    lead = lengths.shape[:-1]
+    b = lengths.shape[-1]
+    flat = lengths.reshape(-1, b)
+    zero = jnp.zeros((flat.shape[0], 1), flat.dtype)
+    splits = jnp.concatenate([zero, jnp.cumsum(flat, axis=1)], axis=1)
+    seg = jax.vmap(functools.partial(ragged_row_ids, capacity=cap))(
+        splits)
+    return splits.reshape(*lead, b + 1), seg.reshape(*lead, cap)
+
+
+def ragged_decode(de, g, b: int, region, rows, roff, valid,
+                  need_counts: bool = True, rbase=None):
+    """Decode one ragged group region ``[world, n*(cap+b)]`` into
+    ``(values, lengths, seg, grow, counts)``, all ``[world, n, ...]``.
+    Dead slots get zero lengths, so every position routes to the dropped
+    segment ``b``. ``valid=None`` means every slot is statically live
+    (skips the mask multiply); ``need_counts=False`` skips the
+    mean-divisor counts (sum-only groups never read them); ``rbase``
+    (row-sliced slots) is subtracted from the raw values before the
+    clip — ``values`` stays raw so callers mask consistently."""
+    world = de.world_size
+    with obs.scope("ragged_decode"):
+        r3 = region.reshape(world, g.n, g.blen)
+        values = r3[:, :, :g.hot]
+        lengths = r3[:, :, g.hot:g.hot + b]  # "rw" blocks carry weight
+        # bits past the lengths (decoded by region_weights)
+        if valid is not None:
+            lengths = lengths * valid[None, :, None].astype(r3.dtype)
+        _, seg = csr_seg(lengths, g.hot)
+        loc = (values - rbase[None, :, None] if rbase is not None
+               else values)
+        grow = (jnp.clip(loc, 0, (rows - 1)[None, :, None])
+                + roff[None, :, None])
+        counts = jnp.maximum(lengths, 1) if need_counts else None
+        return values, lengths, seg, grow, counts
+
+
+def region_weights(de, g, b: int, region) -> jax.Array:
+    """Decode a weighted-ragged ("rw") region's per-id weights
+    ``[world, n, cap]`` from the bitcast payload past the lengths."""
+    world = de.world_size
+    r3 = region.reshape(world, g.n, g.blen)
+    bits = r3[:, :, g.hot + b:].astype(jnp.int32)
+    return lax.bitcast_convert_type(bits, jnp.float32)
+
+
+def ragged_scatter_idx(g, b: int, world: int, seg) -> jax.Array:
+    """Flattened per-value output index into a ``[world*n*(b+1), w]``
+    segment buffer; row ``b`` of each slot is the dropped sentinel."""
+    s_ix = jnp.arange(world, dtype=seg.dtype)[:, None, None]
+    f_ix = jnp.arange(g.n, dtype=seg.dtype)[None, :, None]
+    return (s_ix * g.n + f_ix) * (b + 1) + seg
+
+
+def plan_lookup(de, plan, params, ids_recv) -> jax.Array:
+    """All local lookups in exchange-row layout ``[world, b, s_max]``
+    (``compute_dtype`` — the pre-comm mixed-precision cast, reference
+    ``dist_model_parallel.py:300``). Dead slots produce garbage columns
+    that no consumer ever slices."""
+    world = de.world_size
+    b = plan.b
+    # plan_lookup_groups already casts to compute_dtype; only the
+    # no-groups zeros fallback needs the explicit dtype
+    zdt = (de.compute_dtype
+           or next(iter(params.values())).dtype)
+    sections = [
+        red.transpose(0, 2, 1, 3).reshape(world, b, -1)
+        for red in plan_lookup_groups(de, plan, params, ids_recv)]
+    return (jnp.concatenate(sections, axis=2) if sections
+            else de._vary(jnp.zeros((world, b, plan.s_max), zdt)))
+
+
+def plan_lookup_groups(de, plan, params, ids_recv) -> List[jax.Array]:
+    """Per-group combined lookups in slot-major ``[world, n, b, width]``
+    layout: one region reshape, one slab gather, one combine per group.
+    The single-worker forward consumes these directly (its per-instance
+    outputs are plain slot slices), skipping the ``[world, b, s_max]``
+    exchange-row transpose that only the all-to-all needs — the dense
+    model re-stacks outputs feature-major anyway, so the transpose
+    round trip was a pure extra pass at headline shapes."""
+    my = de._my_rank()
+    sections = []
+    for gi, g in enumerate(plan.groups):
+        # one named scope per (width, kind) group: a profile of the
+        # step attributes gather/combine time to the width it serves
+        with obs.scope(f"lookup_w{g.width}_{g.kind}"):
+            red = lookup_group(de, plan, gi, g, params[_wkey(g.width)],
+                               ids_recv, my, plan.b)
+        dt = de.compute_dtype
+        sections.append(red.astype(dt) if dt is not None else red)
+    return sections
+
+
+def lookup_group(de, plan, gi: int, g, slab, ids_recv, my,
+                 b: int) -> jax.Array:
+    """One exchange group's combined lookup in slot-major
+    ``[world, n, b, width]`` layout (the body of
+    :func:`plan_lookup_groups`, split out so each group runs under its
+    own named scope)."""
+    world = de.world_size
+    rows = de._plan_row(plan.rows[gi], my)
+    roff = de._plan_row(plan.roff[gi], my)
+    # mean/valid are *static* plan tensors: when no slot on any rank
+    # is a mean combiner (resp. dead), the divide (resp. mask) is
+    # skipped at trace time — sum-only groups never touch counts
+    any_mean = bool(plan.mean[gi].any())
+    all_mean = bool(plan.mean[gi].all())
+    all_valid = bool((plan.valid[gi] > 0).all())
+    # row-sliced slots subtract their range base and must read zero
+    # outside the range (their outputs SUM across slices); the same
+    # mask doubles as the opt-in masked_reads debug contract. The
+    # mask is gated PER SLOT (plan.rsliced): an unsliced table that
+    # shares the exchange group keeps the documented
+    # clip-to-last-row read unless masked_reads=True.
+    any_rslice = bool(plan.rsliced[gi].any())
+    use_mask = any_rslice or de.masked_reads
+    rbase = (de._plan_row(plan.rbase[gi], my) if any_rslice
+             else None)
+    region = lax.slice(ids_recv, (0, g.goff),
+                       (world, g.goff + g.n * g.blen))
+    if g.kind == "d":
+        ids = region.reshape(world, g.n, b, g.hot)
+        if rbase is not None:
+            ids = ids - rbase[None, :, None, None]
+        grow = (jnp.clip(ids, 0, (rows - 1)[None, :, None, None])
+                + roff[None, :, None, None])
+        gath = ps.packed_gather(slab, grow, g.width)
+        if use_mask:
+            inr = ((ids >= 0) & (ids < rows[None, :, None, None]))
+            if not de.masked_reads:  # only sliced slots mask
+                rsl = de._plan_row(plan.rsliced[gi], my)
+                inr = inr | (rsl[None, :, None, None] == 0)
+            gath = gath * inr[..., None].astype(gath.dtype)
+        red = jnp.sum(gath, axis=3)  # [world, n, b, w]
+        if g.hot > 1 and any_mean:
+            if all_mean:
+                red = red / g.hot
+            else:
+                mean = de._plan_row(plan.mean[gi], my)
+                red = jnp.where(mean[None, :, None, None] > 0,
+                                red / g.hot, red)
+    else:
+        values, _, seg, grow, counts = ragged_decode(
+            de, g, b, region, rows, roff,
+            None if all_valid else de._plan_row(plan.valid[gi], my),
+            need_counts=any_mean, rbase=rbase)
+        gath = ps.packed_gather(slab, grow, g.width)  # [w, n, cap, ww]
+        if g.kind == "rw":
+            # per-id weights multiply the gathered rows (reference
+            # kernel's optional weights, .cu:52-55); mean still
+            # divides by the id count (.cu:220-222)
+            wts = region_weights(de, g, b, region)
+            gath = gath * wts[..., None].astype(gath.dtype)
+        if use_mask:
+            loc = (values - rbase[None, :, None]
+                   if rbase is not None else values)
+            inr = ((loc >= 0) & (loc < rows[None, :, None]))
+            if not de.masked_reads:  # only sliced slots mask
+                rsl = de._plan_row(plan.rsliced[gi], my)
+                inr = inr | (rsl[None, :, None] == 0)
+            gath = gath * inr[..., None].astype(gath.dtype)
+        sidx = ragged_scatter_idx(g, b, world, seg)
+        buf = jnp.zeros((world * g.n * (b + 1), g.width), gath.dtype)
+        # sidx ascends globally: (source, slot) blocks are laid out
+        # ascending and seg ascends within each CSR block
+        buf = buf.at[sidx.reshape(-1)].add(
+            gath.reshape(-1, g.width), indices_are_sorted=True)
+        red = buf.reshape(world, g.n, b + 1, g.width)[:, :, :b, :]
+        if any_mean:
+            div = red / counts[..., None].astype(red.dtype)
+            if all_mean:
+                red = div
+            else:
+                mean = de._plan_row(plan.mean[gi], my)
+                red = jnp.where(mean[None, :, None, None] > 0,
+                                div, red)
+    return red

@@ -121,6 +121,8 @@ class SparseSGD:
     exact, which the equivalence test engineers)."""
 
     needs_dedup = False
+    #: streaming moment hygiene: SGD carries no slab state to reset
+    fresh_row_fill = 0.0
 
     def init(self, params):
         return jax.tree.map(lambda _: (), params)
@@ -170,6 +172,10 @@ class SparseAdagrad:
     def __init__(self, initial_accumulator_value: float = 0.1,
                  eps: float = 1e-7, dense_apply_ratio: float = 6.0):
         self.initial_accumulator_value = initial_accumulator_value
+        # streaming moment hygiene (parallel/streaming.py commit): a
+        # freshly admitted slot's accumulator resets to the same value a
+        # fresh table init would give it
+        self.fresh_row_fill = initial_accumulator_value
         self.eps = eps
         # dense-apply wins when stream * ratio > slab rows: the sparse path
         # pays ~4.5 random row ops/stream row at 10-15 ns, the dense path
@@ -270,6 +276,8 @@ class SparseMomentum:
 
     needs_dedup = True
     needs_touch_mask = True
+    #: streaming moment hygiene: momentum traces init (and reset) to zero
+    fresh_row_fill = 0.0
 
     def __init__(self, momentum: float = 0.9, nesterov: bool = False):
         self.momentum = momentum
@@ -309,6 +317,9 @@ class SparseAdam:
 
     needs_dedup = True
     needs_touch_mask = True
+    #: streaming moment hygiene: mu/nu init (and reset) to zero; the
+    #: non-slab step count is never touched (shape-matched in commit)
+    fresh_row_fill = 0.0
 
     def __init__(self, b1: float = 0.9, b2: float = 0.999,
                  eps: float = 1e-8, eps_root: float = 0.0):

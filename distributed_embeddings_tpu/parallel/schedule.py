@@ -1,0 +1,221 @@
+"""The explicit step schedule: named phases, declared ordering, declared
+overlap.
+
+The hybrid step is a fixed chain of phases — id exchange, lookup, output
+exchange, dense forward/backward, gradient exchange, sparse apply — that
+used to exist only implicitly, as the order of statements inside one
+2,200-line module. This module makes the schedule a first-class object:
+
+* each phase has a **name** that doubles as its ``obs.scope`` label, so
+  the same identifier threads from the Python orchestration through the
+  jaxpr auditor's collective contract, the HLO census's pass budgets, and
+  the schedule auditor's dependency DAG
+  (:mod:`~..analysis.schedule_audit`);
+* a :class:`StepSchedule` declares, per phase, what it must run
+  **after** and what it claims to **overlap** with. The declaration is a
+  CONTRACT, not a wish: ``tools/schedule_audit.py --strict`` checks every
+  declared overlap against the dependency structure of the compiled
+  program and fails when the overlap does not exist in what XLA emitted
+  (a schedule that *says* "the id exchange hides under dense compute"
+  while the program serializes them is exactly the silent perf lie the
+  auditor exists to catch).
+
+The executor modules (:mod:`.exchange`, :mod:`.lookup`, :mod:`.apply`)
+take their scope names from the constants below; the orchestrator
+(:meth:`~.dist_embedding.DistributedEmbedding.forward_with_residuals` +
+:meth:`~.dist_embedding.DistributedEmbedding.sparse_apply_gradients`)
+steps through :func:`default_schedule`'s phases in declaration order.
+Today's default schedule is honest about being SERIALIZED — every
+collective declares ``overlaps=()`` — which the schedule auditor's
+baseline report documents as the measured starting line; a pipelined
+step (ROADMAP item 2) will ship a schedule whose declared overlaps the
+same auditor then has to certify.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+# ---------------------------------------------------------------- phase names
+# These strings ARE the obs.scope labels of the compiled step (and hence
+# the detpu/ phase paths in the optimized HLO). Globs (trailing ``*``)
+# name phase FAMILIES that expand per width group at trace time.
+
+#: dp→mp id all-to-all (block assembly + the collective)
+PHASE_ID_EXCHANGE = "id_all_to_all"
+#: per-(width, kind) gather+combine groups — ``lookup_w{w}_{kind}``
+PHASE_LOOKUP = "lookup_*"
+#: mp→dp activation all-to-all
+PHASE_OUT_EXCHANGE = "out_all_to_all"
+#: the dense model's forward + backward (trainer scope)
+PHASE_DENSE = "dense_forward_backward"
+#: reverse (cotangent) all-to-all
+PHASE_GRAD_EXCHANGE = "grad_all_to_all"
+#: per-width optimizer scatter streams — ``sparse_apply`` and
+#: ``sparse_apply_w{k}``
+PHASE_APPLY = "sparse_apply*"
+
+
+class ScheduleError(ValueError):
+    """An inconsistent :class:`StepSchedule` declaration."""
+
+
+@dataclasses.dataclass(frozen=True)
+class PhaseDecl:
+    """One named phase of the step schedule.
+
+    ``name`` is the ``obs.scope`` label (an ``fnmatch`` glob for phase
+    families like ``lookup_*``). ``kind`` is ``"collective"`` (pays ICI
+    bandwidth) or ``"compute"`` (pays HBM bandwidth). ``after`` lists the
+    phases that must have produced this phase's inputs — the declared
+    dependency order. ``overlaps`` lists the phases this one CLAIMS to
+    run concurrently with; the schedule auditor verifies each claim
+    against the compiled program's dependency DAG and fails a declared
+    overlap the program serializes."""
+
+    name: str
+    kind: str = "compute"
+    after: Tuple[str, ...] = ()
+    overlaps: Tuple[str, ...] = ()
+
+    def __post_init__(self) -> None:
+        if self.kind not in ("collective", "compute"):
+            raise ScheduleError(
+                f"phase {self.name!r}: kind must be 'collective' | "
+                f"'compute', got {self.kind!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class StepSchedule:
+    """A named, ordered set of :class:`PhaseDecl`\\ s.
+
+    Declaration order is execution order for the serialized portions of
+    the step; ``validate()`` (run on construction) checks the references
+    and rejects ordering cycles, self-overlap, and overlap claims that
+    contradict the declared ``after`` chain (a phase cannot overlap a
+    phase it depends on)."""
+
+    name: str
+    phases: Tuple[PhaseDecl, ...]
+
+    def __post_init__(self) -> None:
+        self.validate()
+
+    # -- introspection ----------------------------------------------------
+    def by_name(self) -> Dict[str, PhaseDecl]:
+        return {p.name: p for p in self.phases}
+
+    def phase(self, name: str) -> PhaseDecl:
+        try:
+            return self.by_name()[name]
+        except KeyError:
+            raise ScheduleError(
+                f"schedule {self.name!r} declares no phase {name!r} "
+                f"(has: {[p.name for p in self.phases]})") from None
+
+    def collectives(self) -> Tuple[PhaseDecl, ...]:
+        return tuple(p for p in self.phases if p.kind == "collective")
+
+    def declared_overlaps(self) -> Tuple[Tuple[str, str], ...]:
+        """Every (phase, partner) overlap claim, in declaration order."""
+        return tuple((p.name, q) for p in self.phases for q in p.overlaps)
+
+    def depends_on(self, name: str, other: str) -> bool:
+        """Whether phase ``name`` transitively runs after ``other``."""
+        decls = self.by_name()
+        seen = set()
+        stack = [name]
+        while stack:
+            cur = stack.pop()
+            if cur in seen or cur not in decls:
+                continue
+            seen.add(cur)
+            for dep in decls[cur].after:
+                if dep == other:
+                    return True
+                stack.append(dep)
+        return False
+
+    # -- validation -------------------------------------------------------
+    def validate(self) -> "StepSchedule":
+        names = [p.name for p in self.phases]
+        if len(set(names)) != len(names):
+            dup = sorted({n for n in names if names.count(n) > 1})
+            raise ScheduleError(
+                f"schedule {self.name!r}: duplicate phase name(s) {dup}")
+        known = set(names)
+        for p in self.phases:
+            for ref in p.after + p.overlaps:
+                if ref not in known:
+                    raise ScheduleError(
+                        f"schedule {self.name!r}: phase {p.name!r} "
+                        f"references undeclared phase {ref!r}")
+            if p.name in p.overlaps:
+                raise ScheduleError(
+                    f"schedule {self.name!r}: phase {p.name!r} cannot "
+                    "overlap itself")
+        # cycle check over the `after` relation (iterative DFS)
+        decls = self.by_name()
+        color: Dict[str, int] = {}  # 0 in-stack, 1 done
+
+        def visit(root: str) -> None:
+            stack = [(root, iter(decls[root].after))]
+            color[root] = 0
+            while stack:
+                node, it = stack[-1]
+                dep = next(it, None)
+                if dep is None:
+                    color[node] = 1
+                    stack.pop()
+                    continue
+                c = color.get(dep)
+                if c == 0:
+                    chain = [n for n, _ in stack] + [dep]
+                    raise ScheduleError(
+                        f"schedule {self.name!r}: ordering cycle "
+                        f"{' -> '.join(chain)}")
+                if c is None:
+                    color[dep] = 0
+                    stack.append((dep, iter(decls[dep].after)))
+
+        for n in names:
+            if n not in color:
+                visit(n)
+        # an overlap claim against a phase this phase (transitively)
+        # depends on is self-contradictory: the data dependency forces
+        # serialization regardless of what the compiler does
+        for p in self.phases:
+            for q in p.overlaps:
+                if self.depends_on(p.name, q) or self.depends_on(q, p.name):
+                    raise ScheduleError(
+                        f"schedule {self.name!r}: phase {p.name!r} "
+                        f"declares overlap with {q!r} but the `after` "
+                        "chain orders them — a data dependency cannot "
+                        "overlap")
+        return self
+
+
+def default_schedule() -> StepSchedule:
+    """The serialized baseline schedule of today's hybrid step.
+
+    Honest declaration of what the unpipelined step does: the three
+    all-to-alls sit strictly between their producers and consumers, and
+    no phase claims overlap. This is the schedule the auditor's baseline
+    report certifies (all three collectives serialized on the critical
+    path) and the one every A/B-identity guarantee is pinned against."""
+    return StepSchedule(
+        name="serialized-v1",
+        phases=(
+            PhaseDecl(PHASE_ID_EXCHANGE, kind="collective"),
+            PhaseDecl(PHASE_LOOKUP, kind="compute",
+                      after=(PHASE_ID_EXCHANGE,)),
+            PhaseDecl(PHASE_OUT_EXCHANGE, kind="collective",
+                      after=(PHASE_LOOKUP,)),
+            PhaseDecl(PHASE_DENSE, kind="compute",
+                      after=(PHASE_OUT_EXCHANGE,)),
+            PhaseDecl(PHASE_GRAD_EXCHANGE, kind="collective",
+                      after=(PHASE_DENSE,)),
+            PhaseDecl(PHASE_APPLY, kind="compute",
+                      after=(PHASE_GRAD_EXCHANGE,)),
+        ))

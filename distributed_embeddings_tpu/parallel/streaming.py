@@ -364,7 +364,7 @@ def remap_width(wstate: Dict[str, jax.Array], stream: WidthStream,
 
 
 def commit(de, params: Dict[str, jax.Array], pending, old_state,
-           enable=None):
+           enable=None, opt_state=None, optimizer=None):
     """Apply one step's staged streaming transitions — called by the
     trainer AFTER the optimizer scatter, next to the nan-guard so a
     skipped step leaves the slot map, sketch, counters AND slabs
@@ -374,23 +374,29 @@ def commit(de, params: Dict[str, jax.Array], pending, old_state,
     * claimed slab rows are ZEROED in the (post-apply) width slabs via an
       O(claims) lane-masked scatter (gather current lanes, add the
       negative) — never a slab-wide pass; with ``enable=False`` the rows
-      route to the dropped sentinel exactly like the optimizer skip.
-      (The PARAM row zeroes; slab-shaped optimizer moments are left as
-      the evictee's — exact under stateless SGD, a damped warm start
-      under Adagrad/Adam. Deterministic either way; see the userguide
-      caveat.);
+      route to the dropped sentinel exactly like the optimizer skip;
+    * with ``opt_state``/``optimizer`` given, every SLAB-SHAPED optimizer
+      state leaf is reset on the claimed rows in the same commit scatter
+      machinery, to the optimizer's declared fresh-row value
+      (``fresh_row_fill``: Adagrad's ``initial_accumulator_value``, zero
+      for momentum/Adam moments) — an admitted id's moments start
+      exactly like a freshly initialized table's, not as the evictee's
+      leftovers. Non-slab leaves (Adam's step count) are untouched;
     * the new slot-map/sketch state is where-selected against the old
       (streaming state is MBs, not GBs — a select is cheap);
     * cumulative counters advance by the (gated) per-step stats.
 
-    Returns ``(params, new_state, step_stats)`` where ``step_stats`` is
-    the gated per-step counter dict the trainer surfaces as the
-    ``stream_*`` step metrics.
+    Returns ``(params, new_state, step_stats)`` — or ``(params,
+    opt_state, new_state, step_stats)`` when ``opt_state`` is given —
+    where ``step_stats`` is the gated per-step counter dict the trainer
+    surfaces as the ``stream_*`` step metrics.
     """
     from ..ops import packed_slab as ps
     from ..utils import obs
 
     new_state = dict(old_state)
+    if opt_state is not None:
+        opt_state = dict(opt_state)
     totals = {k: jnp.zeros((1,), jnp.float32)
               for k in ("admitted", "evicted", "bucket_ids", "hit_ids")}
     for w, (new_wstate, scrub_rows, stats) in sorted(pending.items()):
@@ -408,6 +414,36 @@ def commit(de, params: Dict[str, jax.Array], pending, old_state,
             phys, pvals = ps.expand_update_rows(-cur, rows, w)
             params = dict(params)
             params[k] = slab.at[phys].add(pvals)
+            if opt_state is not None:
+                # moment hygiene: reset slab-shaped optimizer state on
+                # the claimed rows with the SAME gather/expand/scatter
+                # machinery (O(claims), guard-gated through `rows`);
+                # matching on shape keeps mixed dtypes (fp32 accumulators
+                # over bf16 slabs) and tuple states (Adam) leaf-exact
+                fill = float(getattr(optimizer, "fresh_row_fill", 0.0))
+                slab_shape = tuple(slab.shape)
+
+                def scrub_leaf(leaf, rows=rows, w=w, fill=fill,
+                               slab_shape=slab_shape):
+                    if tuple(getattr(leaf, "shape", ())) != slab_shape:
+                        return leaf
+                    c = ps.packed_gather(leaf, jnp.minimum(
+                        rows, de.rows_cap[w] - 1), w)
+                    # zero-then-add, NOT add(fill - cur): x + (-x) is
+                    # exactly 0 and 0 + fill exactly fill, so the reset
+                    # row is BITWISE the fresh-init value regardless of
+                    # the evictee's magnitude (fill - cur would leave a
+                    # rounding residue, or cancel fill entirely under a
+                    # huge accumulator)
+                    ph, pv = ps.expand_update_rows(-c, rows, w)
+                    leaf = leaf.at[ph].add(pv)
+                    if fill:
+                        _, pf = ps.expand_update_rows(
+                            jnp.full_like(c, fill), rows, w)
+                        leaf = leaf.at[ph].add(pf)
+                    return leaf
+
+                opt_state[k] = jax.tree.map(scrub_leaf, opt_state[k])
             if enable is None:
                 new_state[k] = new_wstate
             else:
@@ -424,6 +460,8 @@ def commit(de, params: Dict[str, jax.Array], pending, old_state,
     new_state["steps"] = old_state["steps"] + one
     for name, v in totals.items():
         new_state[name] = old_state[name] + v
+    if opt_state is not None:
+        return params, opt_state, new_state, totals
     return params, new_state, totals
 
 

@@ -29,6 +29,24 @@ from .dist_embedding import DistributedEmbedding
 from .grads import resolve_dp_gradient
 
 
+#: The SINGLE ordering registry of jit-carried trailing aux arguments to
+#: the step builders (``make_hybrid_train_step`` / ``_loop`` /
+#: ``_eval_step``): ``(kind, parameter_name)`` in the order the aux
+#: states trail the fixed ``(state, cat_inputs, batch)`` prefix. Jit
+#: donation indices, shard_map in/out specs, checkpoint aux manifests
+#: and the resilient driver's rewind all address these positionally, so
+#: the order is LOAD-BEARING: a builder that threads them in any other
+#: order (or adds an undeclared one) silently donates / rewinds the
+#: wrong buffer. The detlint rule ``donated-aux`` reads this tuple by
+#: AST and fails ``make lint`` on any step-builder signature whose
+#: trailing params are undeclared here or out of this order — add the
+#: kind HERE first (future schedule state included), then thread it.
+AUX_ARG_REGISTRY = (
+    ("telemetry", "telem"),
+    ("streaming", "stream"),
+)
+
+
 def _metric_specs(axis_name: str, extra=()):
     """shard_map out_specs for the step-metrics dict: every ``[1]``
     per-device entry concatenates into a ``[world]`` per-rank vector.
@@ -182,11 +200,16 @@ def _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer, lr_schedule,
 
         # commit AFTER the optimizer scatter and UNDER the guard verdict:
         # claimed rows zero post-apply (the evictee's last update is
-        # dropped with its slot), and a skipped step leaves slot map,
-        # sketch, counters and slabs bitwise-unchanged
+        # dropped with its slot), slab-shaped optimizer moments reset to
+        # the optimizer's fresh-row value in the same commit scatter (an
+        # admitted id trains from a fresh-init row AND fresh-init
+        # moments, not the evictee's leftovers), and a skipped step
+        # leaves slot map, sketch, counters, slabs and moments
+        # bitwise-unchanged
         with obs.scope("streaming_commit"):
-            new_emb, new_sstate, sstats = streaming_mod.commit(
-                de, new_emb, spending, sstate, enable=ok)
+            new_emb, new_emb_opt, new_sstate, sstats = streaming_mod.commit(
+                de, new_emb, spending, sstate, enable=ok,
+                opt_state=new_emb_opt, optimizer=emb_optimizer)
 
     with obs.scope("dense_update"):
         updates, dense_opt_state = dense_tx.update(

@@ -14,9 +14,9 @@ import pytest
 
 from distributed_embeddings_tpu.utils import envvars
 from tools import detlint
-from tools.detlint.rules import (bare_except, eager_backend, env_registry,
-                                 hardcoded_capacity, host_fetch,
-                                 module_scope_jax, named_scope,
+from tools.detlint.rules import (bare_except, donated_aux, eager_backend,
+                                 env_registry, hardcoded_capacity,
+                                 host_fetch, module_scope_jax, named_scope,
                                  unsized_unique)
 
 CTX = {"repo": detlint.REPO}
@@ -159,6 +159,45 @@ def test_module_scope_jax_rule():
 
 
 # ------------------------------------------------------- framework pieces
+
+
+def test_donated_aux_registry_resolves():
+    reg = donated_aux.registered_aux(detlint.REPO, dict(CTX))
+    # the two aux kinds the step builders thread today, in signature
+    # order (telemetry first, then streaming — the _with_aux_signature
+    # contract)
+    assert reg == [("telemetry", "telem"), ("streaming", "stream")]
+
+
+def test_donated_aux_wrong_order_and_undeclared_drills():
+    # seeded wrong-order drill: streaming threaded BEFORE telemetry —
+    # jit donation indices and the resilient rewind would then address
+    # the wrong buffer
+    bad_order = ("def step(state, cat_inputs, batch, stream, telem):\n"
+                 "    pass\n")
+    found = _check(donated_aux, bad_order)
+    assert found and "out of registry order" in found[0].message
+    # seeded undeclared drill: a new aux kind threaded without being
+    # registered first
+    undeclared = ("def step(state, cat_inputs, batch, telem, sched):\n"
+                  "    pass\n")
+    found = _check(donated_aux, undeclared)
+    assert found and "undeclared aux arg 'sched'" in found[0].message
+
+
+def test_donated_aux_clean_twins():
+    for ok in (
+        "def step(state, cat_inputs, batch, telem, stream):\n    pass\n",
+        "def step(state, cat_inputs, batch, telem):\n    pass\n",
+        "def loop(state, cat_stacks, batch_stacks, stream):\n    pass\n",
+        # the packed-tuple internal form is exempt (not a jit boundary)
+        "def core(state, cat_inputs, batch, aux):\n    pass\n",
+        # no trailing aux at all
+        "def step(state, cat_inputs, batch):\n    pass\n",
+        # not a step-builder signature
+        "def f(a, b, c, d):\n    pass\n",
+    ):
+        assert not _check(donated_aux, ok), ok
 
 
 def test_discover_rules_finds_all():

@@ -434,3 +434,103 @@ def test_streaming_on_mesh_with_telemetry_combined():
     occ = smod.occupancy(de, sstate)
     assert occ["admitted"] > 0
     assert occ["tables"][0]["table_id"] == 7
+
+
+# ------------------------------------------------- admission moment hygiene
+
+
+@pytest.mark.parametrize("opt_cls", [SparseAdagrad, "adam", "momentum"])
+def test_admitted_slot_moments_reset_to_fresh_init(opt_cls):
+    """ROADMAP 5(b): a claimed slot's slab-shaped optimizer state must
+    reset to the optimizer's fresh-init value in the same commit scatter
+    that zeroes the row — an admitted id's moments start exactly like a
+    freshly initialized table's, never as the evictee's leftovers."""
+    from distributed_embeddings_tpu.parallel import (SparseAdam,
+                                                     SparseMomentum)
+    from distributed_embeddings_tpu.ops import packed_slab as ps
+
+    if opt_cls == "adam":
+        opt = SparseAdam()
+    elif opt_cls == "momentum":
+        opt = SparseMomentum()
+    else:
+        opt = opt_cls()
+    cfg = _stream_cfg(admit_min_count=2)
+    de, state, step = _build([STATIC, streaming_table()], cfg=cfg,
+                             opt=opt, with_metrics=False, nan_guard=False)
+    sstate = init_streaming(de, cfg)
+    wkey = "w4"
+    ext = jnp.full((8,), 42_424_242, jnp.int32)
+    cats = [jnp.zeros((8,), jnp.int32), ext]
+    batch = jnp.zeros((8,), jnp.float32)
+    # pre-dirty every slab-shaped moment with a sentinel the fresh-init
+    # value can never equal: without the reset, the claimed slot would
+    # keep the sentinel (the evictee's-leftovers bug this test pins)
+    SENT = 7.5
+    slab_shape = np.asarray(state.emb_params[wkey][0]).shape
+
+    def dirty(leaf):
+        if np.asarray(leaf).shape[1:] == slab_shape:
+            return jnp.full_like(leaf, SENT)
+        return leaf
+    state = state._replace(
+        emb_opt_state=jax.tree.map(dirty, state.emb_opt_state))
+    # one batch of 8 occurrences pushes the sketch past the gate: the
+    # slot is claimed and the commit scatter must reset row AND moments
+    _, state, sstate = step(state, cats, batch, sstate)
+    fp = np.asarray(sstate[wkey]["slot_fp"][0])
+    claimed = np.nonzero(fp >= 0)[0]
+    assert claimed.size == 1
+    row = int(claimed[0])
+
+    fill = float(getattr(opt, "fresh_row_fill", 0.0))
+    leaves = jax.tree.leaves(state.emb_opt_state[wkey])
+    slab_leaves = [lf for lf in leaves
+                   if np.asarray(lf[0]).shape == slab_shape]
+    assert slab_leaves, "optimizer carries no slab-shaped state?"
+    for leaf in slab_leaves:
+        logical = ps.unpack_rows_np(np.asarray(leaf[0]), 4)
+        np.testing.assert_array_equal(
+            logical[row], np.full((4,), fill, logical.dtype))
+        # a neighbouring slot row nobody touched keeps the sentinel —
+        # the reset is row-targeted, not a slab-wide wipe (slots start
+        # after the static table's 32 rows; capacity 16)
+        untouched = 32 + ((row - 32 + 1) % 16)
+        assert np.all(logical[untouched] == SENT)
+    # the param row itself is zero (the pre-existing contract)
+    logical_p = ps.unpack_rows_np(np.asarray(state.emb_params[wkey][0]), 4)
+    assert np.all(logical_p[row] == 0.0)
+    # step 3: the admitted id now trains its slot — moments move OFF the
+    # fresh value (proves the reset didn't just freeze the row)
+    _, state, sstate = step(state, cats, batch, sstate)
+    moved = False
+    for leaf in jax.tree.leaves(state.emb_opt_state[wkey]):
+        if np.asarray(leaf[0]).shape != slab_shape:
+            continue
+        logical = ps.unpack_rows_np(np.asarray(leaf[0]), 4)
+        if not np.all(logical[row] == fill):
+            moved = True
+    assert moved
+
+
+def test_moment_reset_is_guard_gated():
+    """A nan-guard-skipped step must leave the optimizer moments (like
+    everything else) bitwise-unchanged even when an admission was
+    staged in the same step."""
+    cfg = _stream_cfg(admit_min_count=1)
+    opt = SparseAdagrad()
+    de, state, step = _build([STATIC, streaming_table()], cfg=cfg,
+                             opt=opt, with_metrics=False, nan_guard=True)
+    sstate = init_streaming(de, cfg)
+    ext = jnp.full((8,), 77_777_777, jnp.int32)
+    cats = [jnp.zeros((8,), jnp.int32), ext]
+    before_opt = jax.tree.map(np.asarray, state.emb_opt_state)
+    before_fp = np.asarray(sstate["w4"]["slot_fp"])
+    # poisoned batch: the guard must skip the whole step, moment reset
+    # included
+    bad = jnp.full((8,), np.nan, jnp.float32)
+    _, state, sstate = step(state, cats, bad, sstate)
+    after_opt = jax.tree.map(np.asarray, state.emb_opt_state)
+    jax.tree.map(np.testing.assert_array_equal, before_opt, after_opt)
+    np.testing.assert_array_equal(before_fp,
+                                  np.asarray(sstate["w4"]["slot_fp"]))

@@ -71,7 +71,11 @@ def build_case(name: str, world: int, batch: int):
     Cases: ``dense`` / ``ragged`` / ``row_sliced`` (the tier-1 shapes),
     ``bigvocab`` — vocab rows >> the id stream, so stateful sparse
     optimizers compile their sort-dedup path instead of the dense-apply
-    regime (the configuration the dedup pass budget is pinned on) — and
+    regime (the configuration the dedup pass budget is pinned on) —
+    ``streaming`` — the dense shapes plus one dynamic-vocab table
+    (``"streaming"`` config entry), so the static gates can audit the
+    slot-map remap/commit phases too (build its step with
+    ``dynamic=StreamingConfig(...)``) — and
     ``criteo1tb`` — the REAL 26-table Criteo-1TB vocab vector at dim 128
     with the reference column-slice threshold (``CRITEO1TB_COL_SLICE``),
     the shapes the plan-time capacity auditor (``tools/plan_audit.py``)
@@ -114,6 +118,19 @@ def build_case(name: str, world: int, batch: int):
         configs = [{"input_dim": 5000 + 400 * i, "output_dim": 8,
                     "combiner": ["sum", None, "mean"][i % 3]}
                    for i in range(10)]
+        de = DistributedEmbedding(configs, world_size=world)
+        cats = dense_cats(configs)
+    elif name == "streaming":
+        # the dense shapes plus ONE dynamic-vocab table: capacity slots +
+        # shared bucket rows (input_dim = capacity + buckets, the
+        # streaming slab contract) — the shapes the schedule auditor's
+        # streaming case certifies
+        configs = [{"input_dim": 20 + 6 * i, "output_dim": 4,
+                    "combiner": ["sum", None, "mean"][i % 3]}
+                   for i in range(9)]
+        configs.append({"input_dim": 4096 + 64, "output_dim": 4,
+                        "combiner": "sum",
+                        "streaming": {"capacity": 4096, "buckets": 64}})
         de = DistributedEmbedding(configs, world_size=world)
         cats = dense_cats(configs)
     elif name == "criteo1tb":

@@ -15,10 +15,12 @@ Accepts either the driver's wrapper format (``{"rc": ..., "parsed":
   dropping, or ms-per-iter metrics rising, by more than ``--threshold``,
   default 10%), a nonzero steady-state recompile count, a per-phase
   HLO pass-count regression / contract violation in the candidate's
-  ``phase_budget`` census (:func:`check_phase_budget`), or a
+  ``phase_budget`` census (:func:`check_phase_budget`), a
   ``plan_audit`` capacity failure — contract violation or a
   predicted-vs-measured byte drift beyond ±15%
-  (:func:`check_plan_audit`);
+  (:func:`check_plan_audit`) — or a ``schedule`` overlap regression:
+  ``serialized_collective_fraction`` or modeled critical-path bytes
+  growing versus the baseline (:func:`check_schedule`);
 * 2 — unusable inputs (missing file, no parseable payload).
 
 Metrics present in only one record are reported but never fail the gate
@@ -274,6 +276,72 @@ def check_plan_audit(old: Dict[str, Any], new: Dict[str, Any]) -> int:
     return failures
 
 
+#: tolerated growth of the schedule section's modeled critical-path
+#: bytes (layout jitter between jax/XLA versions moves a few operand
+#: shapes; structural regressions move megabytes)
+SCHEDULE_BYTES_TOL = 0.02
+#: tolerated growth of serialized_collective_fraction (float noise only
+#: — any real re-serialization moves whole collectives, not epsilons)
+SCHEDULE_FRACTION_TOL = 0.005
+
+
+def check_schedule(old: Dict[str, Any], new: Dict[str, Any]) -> int:
+    """The schedule-graph gate (the overlap ratchet): the bench record
+    embeds the schedule auditor's baseline report (``schedule``:
+    serialized_collective_fraction, modeled critical-path bytes, and the
+    per-collective classification of the headline step's dependency
+    DAG). Three checks:
+
+    * any contract / declaration violation in the candidate's own
+      report fails outright;
+    * ``serialized_collective_fraction`` GROWING beyond float tolerance
+      fails — overlap, once won, can never silently regress back to a
+      serialized exchange;
+    * modeled ``critical_path_bytes`` growing beyond
+      :data:`SCHEDULE_BYTES_TOL` fails — a longer dependency chain is a
+      structural regression even before it shows up as milliseconds;
+    * a candidate missing the section while the baseline has it fails
+      (the audit crashed or was skipped — silence would hide exactly
+      the regressions the gate exists to catch).
+    """
+    sec = new.get("schedule")
+    if not isinstance(sec, dict):
+        if isinstance(old.get("schedule"), dict):
+            print("compare_bench: candidate record has no schedule "
+                  "section but the baseline does — the schedule audit "
+                  "failed or was skipped; the overlap gate cannot run",
+                  file=sys.stderr)
+            return 1
+        return 0
+    failures = 0
+    for v in sec.get("violations") or []:
+        print(f"compare_bench: schedule contract violation in the "
+              f"candidate record: {v}", file=sys.stderr)
+        failures += 1
+    osec = old.get("schedule")
+    if not isinstance(osec, dict):
+        return failures
+    of = osec.get("serialized_collective_fraction")
+    nf = sec.get("serialized_collective_fraction")
+    if isinstance(of, (int, float)) and isinstance(nf, (int, float)) \
+            and nf > of + SCHEDULE_FRACTION_TOL:
+        print(f"compare_bench: schedule REGRESSION: "
+              f"serialized_collective_fraction {of:.3f} -> {nf:.3f} — "
+              "a collective that used to overlap dense compute is "
+              "serialized again", file=sys.stderr)
+        failures += 1
+    ob = osec.get("critical_path_bytes")
+    nb2 = sec.get("critical_path_bytes")
+    if isinstance(ob, (int, float)) and isinstance(nb2, (int, float)) \
+            and ob > 0 and nb2 > ob * (1.0 + SCHEDULE_BYTES_TOL):
+        print(f"compare_bench: schedule REGRESSION: modeled "
+              f"critical-path bytes {int(ob)} -> {int(nb2)} "
+              f"(+{(nb2 / ob - 1) * 100:.1f}%) — the step's dependency "
+              "chain got longer", file=sys.stderr)
+        failures += 1
+    return failures
+
+
 #: streaming section contract: the capacity-bounded dynamic table must
 #: keep TRACKING the static-vocab AUC on the day-k/day-k+1 replay (and
 #: actually exercise its admission machinery) — the scenario's whole
@@ -320,6 +388,7 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
     steady_failures = check_steady_state(new)
     steady_failures += check_phase_budget(old, new)
     steady_failures += check_plan_audit(old, new)
+    steady_failures += check_schedule(old, new)
     steady_failures += check_streaming(old, new)
     regressions = 0
     rows = []

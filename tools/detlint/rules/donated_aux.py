@@ -1,0 +1,124 @@
+"""Jit-carried trailing aux step args follow the single ordering registry.
+
+The hybrid step builders thread optional jit-carried aux states
+(telemetry sketches, streaming slot maps, future schedule state) as
+TRAILING positional arguments after the fixed ``(state, cat_inputs,
+batch)`` prefix. Donation indices, shard_map in/out specs, checkpoint
+aux manifests and the resilient driver's generalized rewind all address
+those trailing slots POSITIONALLY — so their order is load-bearing, and
+it is declared exactly once:
+``distributed_embeddings_tpu/parallel/trainer.py::AUX_ARG_REGISTRY``.
+
+This rule resolves the registry by AST (no import) and checks every
+step-builder-shaped function definition in scope — positional params
+beginning ``state, cat_*, batch*`` — requiring each trailing param to be
+a registered aux name, appearing in registry order. An undeclared
+trailing arg ships a donated buffer nothing rewinds; a re-ordered pair
+donates/rewinds the WRONG buffer. Register the kind first, then thread
+it.
+
+``aux`` itself is exempt: it is the PACKED tuple form the internal
+``core(state, cat_inputs, batch, aux)`` helpers take — not a jit
+boundary (the unpacked ``step`` wrappers are).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Tuple
+
+from .. import Finding
+
+NAME = "donated-aux"
+SCOPE = ("distributed_embeddings_tpu/parallel/**",
+         "distributed_embeddings_tpu/analysis/**")
+
+REGISTRY_PATH = "distributed_embeddings_tpu/parallel/trainer.py"
+#: internal packed-tuple carriers, not jit boundaries
+EXEMPT_TRAILING = {"aux"}
+#: leading-prefix spellings of a step-builder signature: (state-ish,
+#: categorical-inputs-ish, batch-ish)
+_STATEISH = ("state", "carry")
+_CATISH = ("cat_inputs", "cat_stacks", "cats")
+_BATCHISH = ("batch", "batch_stacks", "batch_tree")
+
+
+def registered_aux(repo: str, ctx: Optional[dict] = None
+                   ) -> List[Tuple[str, str]]:
+    """The ordered ``(kind, param_name)`` registry, extracted from
+    trainer.py's ``AUX_ARG_REGISTRY`` tuple literal by AST. Cached per
+    run in ``ctx``."""
+    if ctx is not None and "donated_aux_registry" in ctx:
+        return ctx["donated_aux_registry"]
+    out: List[Tuple[str, str]] = []
+    path = os.path.join(repo, REGISTRY_PATH)
+    try:
+        tree = ast.parse(open(path, encoding="utf-8").read(), path)
+    except (OSError, SyntaxError):
+        tree = None
+    if tree is not None:
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "AUX_ARG_REGISTRY"
+                            for t in node.targets)):
+                continue
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                for elt in node.value.elts:
+                    if (isinstance(elt, (ast.Tuple, ast.List))
+                            and len(elt.elts) == 2
+                            and all(isinstance(e, ast.Constant)
+                                    and isinstance(e.value, str)
+                                    for e in elt.elts)):
+                        out.append((elt.elts[0].value, elt.elts[1].value))
+    if ctx is not None:
+        ctx["donated_aux_registry"] = out
+    return out
+
+
+def _is_step_builder_sig(args: ast.arguments) -> bool:
+    pos = [a.arg for a in args.posonlyargs + args.args]
+    if len(pos) >= 1 and pos[0] == "self":
+        pos = pos[1:]
+    if len(pos) < 4:  # no trailing aux -> nothing to check
+        return False
+    return (pos[0] in _STATEISH and pos[1] in _CATISH
+            and pos[2] in _BATCHISH)
+
+
+def check(tree: ast.Module, path: str, src: str, ctx) -> list:
+    registry = registered_aux(ctx.get("repo", "."), ctx)
+    order = {name: i for i, (_, name) in enumerate(registry)}
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _is_step_builder_sig(node.args):
+            continue
+        pos = [a.arg for a in node.args.posonlyargs + node.args.args]
+        if pos and pos[0] == "self":
+            pos = pos[1:]
+        trailing = [p for p in pos[3:] if p not in EXEMPT_TRAILING]
+        last = -1
+        for p in trailing:
+            if p not in order:
+                findings.append(Finding(
+                    NAME, path, node.lineno,
+                    f"step builder {node.name!r} threads undeclared aux "
+                    f"arg {p!r} — declare it in "
+                    f"{REGISTRY_PATH}::AUX_ARG_REGISTRY first (donation "
+                    "indices, shard_map specs and the resilient rewind "
+                    "address trailing aux POSITIONALLY)"))
+                continue
+            if order[p] < last:
+                findings.append(Finding(
+                    NAME, path, node.lineno,
+                    f"step builder {node.name!r} threads aux arg {p!r} "
+                    f"out of registry order (expected the "
+                    f"AUX_ARG_REGISTRY order "
+                    f"{[n for _, n in registry]}) — a re-ordered pair "
+                    "donates/rewinds the WRONG buffer"))
+                continue
+            last = order[p]
+    return findings
