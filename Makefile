@@ -6,7 +6,8 @@ SHELL := /bin/bash
 .PHONY: all native test test-fast bench bench-diff clean pkg verify \
         lint plan-audit audit-step hlo-audit schedule-audit check-backend \
         check-obs check-obs-report check-resilience check-reshard \
-        check-recovery check-streaming obs-report
+        check-recovery check-streaming check-phase-profile obs-report \
+        phase-profile
 
 all: native
 
@@ -29,8 +30,8 @@ bench:
 # no-eager-backend shim), the observability gate, and the
 # preemption-recovery drill — run before shipping a round
 verify: lint plan-audit audit-step hlo-audit schedule-audit check-backend \
-        check-obs check-obs-report check-resilience check-reshard \
-        check-recovery check-streaming
+        check-obs check-obs-report check-phase-profile check-resilience \
+        check-reshard check-recovery check-streaming
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -73,6 +74,19 @@ hlo-audit:
 # schedule (analysis/schedule_audit.py)
 schedule-audit:
 	env JAX_PLATFORMS=cpu python tools/schedule_audit.py --strict
+
+# measured phase-time observatory: run timed steps under
+# jax.profiler.trace on the 8-virtual-device CPU mesh, attribute every
+# trace event to its obs.scope phase, cross-check the measured
+# serialized/overlapped classification against the schedule auditor's
+# model, and render the calibration drift table (measured/modeled cost
+# ratio per phase; analysis/phase_profile.py + tools/phase_profile.py)
+phase-profile:
+	env JAX_PLATFORMS=cpu python tools/phase_profile.py --strict
+
+# the make verify smoke of the above: dense case only, 2 profiled steps
+check-phase-profile:
+	env JAX_PLATFORMS=cpu python tools/phase_profile.py --smoke --strict
 
 # fails if __graft_entry__.py / bench.py reintroduce a pre-probe backend
 # touch (the r5 rc=124 root cause); thin shim over the detlint rule

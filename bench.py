@@ -1024,6 +1024,78 @@ def run_schedule():
     }
 
 
+def run_phase_profile():
+    """Measured phase-time baseline (the observatory's anchor): runs
+    ``tools/phase_profile.py`` in a CHILD process pinned to the
+    virtual-device CPU backend (profiling must never disturb — or wait
+    on — this process's accelerator tunnel) and embeds the measured
+    report for the dense case: per-phase p50 ms, the measured
+    exchange/lookup/apply/dense breakdown, measured a2a and serialized
+    fractions, the capture overhead (profiling is strictly opt-in — the
+    timed headline sections never pay it), and the calibration drift
+    flags against the schedule auditor's cost model.
+    ``tools/compare_bench.py::check_phase_profile`` fails a candidate
+    whose measured serialized fraction GROWS versus the baseline — so
+    measured overlap, once the pipelined step (ROADMAP item 2) wins it,
+    can never silently regress — or whose measured-vs-modeled
+    classification disagrees."""
+    import subprocess
+    import tempfile
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    with tempfile.NamedTemporaryFile(
+            mode="r", suffix=".json", delete=False) as tf:
+        json_path = tf.name
+    cmd = [sys.executable, os.path.join("tools", "phase_profile.py"),
+           "--json", json_path]
+    cmd += ["--smoke"] if SMOKE else ["--case", "dense"]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=900, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"phase_profile rc={proc.returncode}: "
+                f"{proc.stderr[-500:]}")
+        with open(json_path, encoding="utf-8") as fh:
+            records = json.load(fh)
+    finally:
+        try:
+            os.unlink(json_path)
+        except OSError:
+            pass
+    if not records:
+        # rc can be 0 with zero cases when a capture failed non-strict;
+        # an empty section must fail loudly, not ride the record hollow
+        raise RuntimeError(
+            f"phase_profile produced no case records: {proc.stderr[-500:]}")
+    rec = records[0]
+    prof = rec["profile"]
+    return {
+        "label": rec["label"],
+        "measured_serialized_fraction":
+            prof["measured_serialized_fraction"],
+        "step_wall_ms_p50": prof["step_wall_ms_p50"],
+        "group_ms": prof["group_ms"],
+        "a2a_frac": prof["a2a_frac"],
+        "concurrency": prof["concurrency"],
+        "resolved_frac": prof["resolved_frac"],
+        "collectives": prof["collectives"],
+        "modeled_serialized_fraction":
+            rec["modeled"]["serialized_collective_fraction"],
+        "profile_overhead_frac": rec["profile_overhead_frac"],
+        "plain_step_ms": rec["plain_step_ms"],
+        "profiled_step_ms": rec["profiled_step_ms"],
+        "calibration_scale":
+            rec["calibration"]["scale_measured_over_modeled"],
+        "calibration_flagged": rec["calibration"]["flagged"],
+        "violations": rec["agreement_violations"],
+        "steps": rec["steps"],
+    }
+
+
 def run_telemetry_overhead():
     """Access-telemetry cost (ISSUE 5): the SAME single-chip DLRM step
     timed with the jit-carried telemetry compiled OUT (the headline
@@ -1375,6 +1447,8 @@ def main():
             "metric": "dlrm_samples_per_sec_per_chip", "value": 0.0,
             "unit": "samples/s", "vs_baseline": 0.0,
             "error": f"backend unavailable: {probe.error}",
+            "backend": probe.platform,
+            "device_count": probe.device_count,
             "probe": probe.to_json()}))
         return
     # environment stamp: lets compare_bench refuse to diff records from
@@ -1448,6 +1522,13 @@ def main():
         "metric": "dlrm_samples_per_sec_per_chip",
         "value": round(best, 1),
         "unit": "samples/s",
+        # the probe VERDICT, top-level: every number below was produced
+        # on THIS backend, and tools/compare_bench.py refuses to diff
+        # records whose backends disagree (the BENCH_r04-vs-r05 CPU/TPU
+        # confusion trap — a CPU-proxy record must never silently gate a
+        # TPU capture)
+        "backend": probe.platform,
+        "device_count": probe.device_count,
         "vs_baseline": round(best / BASELINE_SAMPLES_PER_SEC_PER_CHIP, 3),
         "variant": ("bf16_params" if best == bf16p
                     else "bf16" if best == bf16 else "fp32"),
@@ -1526,6 +1607,14 @@ def main():
         # candidate whose per-phase gated pass counts regress (and any
         # record whose own pass-budget contracts are violated)
         out["phase_budget"] = pb
+    pprof = _guard("phase_profile", run_phase_profile)
+    if pprof is not None:
+        # the MEASURED phase baseline rides the record so
+        # tools/compare_bench.py::check_phase_profile can fail a
+        # candidate whose measured serialized fraction grows or whose
+        # measured-vs-modeled classification disagrees (the measured
+        # half of the overlap ratchet)
+        out["phase_profile"] = pprof
     sched = _guard("schedule", run_schedule)
     if sched is not None:
         # the dependency-DAG baseline rides the record so

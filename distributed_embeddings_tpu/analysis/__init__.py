@@ -27,6 +27,19 @@ serialized-on or overlappable-with dense compute — enforced as
 :class:`~.schedule_audit.ScheduleContract` s and as the
 :class:`~..parallel.schedule.StepSchedule` declaration check by
 ``tools/schedule_audit.py --strict`` (= ``make schedule-audit``).
+
+:mod:`.phase_profile` is the MEASURED counterpart of all of the above:
+it runs N timed steps under ``jax.profiler.trace``, attributes every
+op-level trace event to its ``obs.scope`` phase (via the jax-free
+``utils/traceparse.py`` parser + the compiled module's own
+``metadata.op_name`` text), reduces them to a
+:class:`~.phase_profile.PhaseProfile` (per-phase p50/p95 ms, measured
+exchange/lookup/apply/dense breakdown, measured a2a and overlap
+fractions), calibrates the schedule auditor's byte-cost model against
+the clock (:func:`~.phase_profile.calibrate` drift table), and
+cross-checks the measured vs modeled serialized/overlappable
+classification (:func:`~.phase_profile.check_agreement`) — enforced by
+``tools/phase_profile.py --strict`` (= ``make phase-profile``).
 """
 
 from .audit import (
@@ -64,6 +77,16 @@ from .plan_audit import (
     default_contract,
     rank_strategies,
 )
+from . import phase_profile
+from .phase_profile import (
+    CalibrationReport,
+    HloPhaseIndex,
+    PhaseProfile,
+    PhaseProfileError,
+    calibrate,
+    check_agreement,
+    profile_steps,
+)
 from . import schedule_audit
 from .schedule_audit import (
     CollectiveInfo,
@@ -99,6 +122,13 @@ __all__ = [
     "table_memory_report",
     "compiled_step_report",
     "step_memory_report",
+    "CalibrationReport",
+    "HloPhaseIndex",
+    "PhaseProfile",
+    "PhaseProfileError",
+    "calibrate",
+    "check_agreement",
+    "profile_steps",
     "CensusError",
     "CensusReport",
     "PassBudget",

@@ -51,6 +51,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 
+from ..utils import obs
+
 #: census op kinds a PassBudget can cap (plus "convert_roundtrip" and
 #: "fusion"); these are the row-op passes of the ROADMAP 3(a) budget
 ROW_OP_KINDS = ("gather", "scatter", "sort", "cumsum", "all_to_all",
@@ -84,7 +86,10 @@ _INST_RE = re.compile(
     r"(?P<shape>\((?:[^()]|\([^()]*\))*\)|\S+)\s+"
     r"(?P<op>[a-z][\w\-]*)\(")
 _OPNAME_RE = re.compile(r'op_name="([^"]*)"')
-_DETPU_RE = re.compile(r"detpu/([\w.\-]+)")
+# the phase-name extractor is SHARED with the scope writer (utils/obs.py
+# mints the names) and with the measured-trace parser, so the static and
+# measured attributions can never drift onto different spellings
+_DETPU_RE = obs.SCOPE_RE
 _SHAPE_TOKEN_RE = re.compile(
     r"\b(pred|bf16|f8\w+|[fsuc]\d+)\[([\d,]*)\]")
 

@@ -571,6 +571,13 @@ class ScheduleReport:
     total_compute_ns: float
     overlap_min_ratio: float
     violations: List[str]
+    #: modeled cost summed per detpu phase path (non-trivial nodes,
+    #: collectives included under their exchange phase) — the modeled
+    #: half of the measured-vs-modeled drift table
+    #: (:func:`~.phase_profile.calibrate` joins measured trace durations
+    #: against exactly these keys)
+    phase_cost_ns: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -695,6 +702,8 @@ class ScheduleReport:
             critical_path_phases=[
                 {"phase": p, "cost_ns": round(ns, 3)}
                 for p, ns in self.critical_path_phases],
+            phase_cost_ns={k or "(unscoped)": round(v, 3)
+                           for k, v in self.phase_cost_ns.items()},
             collectives=[c.to_json() for c in self.collectives])
         return d
 
@@ -773,6 +782,11 @@ def analyze_graph(graph: ScheduleGraph, *, label: str = "step",
             runs[-1] = (n.phase, runs[-1][1] + n.cost_ns)
         else:
             runs.append((n.phase, n.cost_ns))
+    phase_cost: Dict[str, float] = {}
+    for n in graph.nodes:
+        if n.is_trivial or n.cost_ns <= 0:
+            continue
+        phase_cost[n.phase] = phase_cost.get(n.phase, 0.0) + n.cost_ns
     return ScheduleReport(
         label=label, world=graph.world, chip=graph.chip.name,
         backend=backend,
@@ -793,7 +807,8 @@ def analyze_graph(graph: ScheduleGraph, *, label: str = "step",
         total_compute_ns=sum(n.cost_ns for n in graph.nodes
                              if not n.is_collective and not n.is_trivial),
         overlap_min_ratio=overlap_min_ratio,
-        violations=[])
+        violations=[],
+        phase_cost_ns=phase_cost)
 
 
 def audit_text(txt: str, *, label: str = "step", world: int = 1,

@@ -141,6 +141,21 @@ declare("DETPU_PROFILE_PORT", default=None,
         doc="port for a live jax profiler server (obs.maybe_start_server); "
             "unset = no server")
 
+# measured phase-time observatory (analysis/phase_profile.py +
+# tools/phase_profile.py = make phase-profile)
+declare("DETPU_PHASE_PROFILE_STEPS", default="5",
+        doc="timed steps captured per case by the measured phase profile "
+            "(each step gets its own jax.profiler.trace so per-phase "
+            "numbers carry real p50/p95 spread)")
+declare("DETPU_PHASE_PROFILE_DIR", default=None,
+        doc="keep the phase-profile trace captures (TensorBoard-loadable) "
+            "under this directory instead of a deleted temp dir")
+declare("DETPU_PHASE_DRIFT_MAX", default="2.0",
+        doc="calibration flag threshold: a phase whose measured/modeled "
+            "cost ratio exceeds this factor (or falls below its inverse) "
+            "relative to the step's cost-weighted median ratio is "
+            "reported as model drift (analysis.phase_profile.calibrate)")
+
 # streaming vocab: frequency-gated admission + approximate-LFU eviction
 # (parallel/streaming.py; carried through train steps built by
 # parallel/trainer.py with dynamic=)

@@ -44,6 +44,7 @@ import dataclasses
 import json
 import logging
 import os
+import re
 import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional
@@ -132,6 +133,35 @@ def nanguard_escalation_k(default: int = 3) -> int:
 
 # ------------------------------------------------------------- named scopes
 
+#: Prefix every :func:`scope` stamps on its ``jax.named_scope`` — the one
+#: identifier that threads a phase through the jaxpr auditor, the HLO
+#: census, the schedule-graph auditor, and the measured trace parser.
+SCOPE_PREFIX = "detpu"
+
+#: The phase-name extractor every consumer of ``metadata.op_name`` shares
+#: (``analysis/hlo_census.py`` compiled-HLO attribution, the schedule
+#: auditor's DAG nodes, ``utils/traceparse.py``'s profiler events): each
+#: match is one ``detpu/<component>`` scope level. Lives HERE — next to
+#: :func:`scope`, which mints the names, and derived from the same
+#: :data:`SCOPE_PREFIX` — so the writer and every reader agree by
+#: construction.
+SCOPE_RE = re.compile(re.escape(SCOPE_PREFIX) + r"/([\w.\-]+)")
+
+
+def phase_path(op_name: Optional[str]) -> str:
+    """Full ``detpu`` scope path embedded in an XLA ``op_name`` (or a
+    profiler event's metadata), e.g.
+    ``"jit(step)/.../detpu/embedding_forward/detpu/id_all_to_all/..."``
+    -> ``"embedding_forward/id_all_to_all"``. Empty string when the name
+    carries no detpu scope."""
+    return "/".join(SCOPE_RE.findall(op_name or ""))
+
+
+def phase_leaf(path: str) -> str:
+    """Last component of a phase path (census convention: contracts match
+    the full path OR the leaf)."""
+    return path.rsplit("/", 1)[-1] if path else ""
+
 
 def scope(name: str):
     """``jax.named_scope("detpu/<name>")`` — phase attribution for XLA
@@ -139,7 +169,7 @@ def scope(name: str):
     use it unconditionally."""
     import jax
 
-    return jax.named_scope(f"detpu/{name}")
+    return jax.named_scope(f"{SCOPE_PREFIX}/{name}")
 
 
 @contextlib.contextmanager

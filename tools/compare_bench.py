@@ -18,17 +18,21 @@ Accepts either the driver's wrapper format (``{"rc": ..., "parsed":
   ``phase_budget`` census (:func:`check_phase_budget`), a
   ``plan_audit`` capacity failure — contract violation or a
   predicted-vs-measured byte drift beyond ±15%
-  (:func:`check_plan_audit`) — or a ``schedule`` overlap regression:
+  (:func:`check_plan_audit`) — a ``schedule`` overlap regression:
   ``serialized_collective_fraction`` or modeled critical-path bytes
-  growing versus the baseline (:func:`check_schedule`);
+  growing versus the baseline (:func:`check_schedule`) — or a MEASURED
+  overlap regression: the trace-parsed ``phase_profile`` section's
+  measured serialized fraction growing, or its measured-vs-modeled
+  classification disagreeing (:func:`check_phase_profile`);
 * 2 — unusable inputs (missing file, no parseable payload).
 
 Metrics present in only one record are reported but never fail the gate
-(rounds legitimately add sections). When both records carry the PR 2
-``env`` stamp (backend, device count, jax version), a mismatch is printed
-loudly — numbers from different hardware are compared only because you
-asked, not silently. Wired as ``make bench-diff``
-(``OLD=... NEW=... make bench-diff``).
+(rounds legitimately add sections). Records from DIFFERENT backends or
+device counts (the top-level probe stamp, falling back to the PR 2
+``env`` block) are REFUSED outright — the BENCH_r04-vs-r05 CPU/TPU
+confusion trap; ``--allow-env-mismatch`` downgrades that to a loud
+warning when cross-backend reading is deliberate. Wired as ``make
+bench-diff`` (``OLD=... NEW=... make bench-diff``).
 
 No jax import: this must run anywhere, instantly.
 """
@@ -124,16 +128,52 @@ def load_bench(path: str) -> Optional[Dict[str, Any]]:
     return doc
 
 
-def check_env(old: Dict[str, Any], new: Dict[str, Any]) -> None:
-    """Print a loud warning when the PR 2 env stamps disagree."""
+def _stamp(rec: Dict[str, Any], key: str):
+    """A record's backend-identity field: the top-level probe verdict
+    (stamped since the phase-profile round), falling back to the PR 2
+    ``env`` block for older records."""
+    if key in rec:
+        return rec[key]
+    env = rec.get("env")
+    return env.get(key) if isinstance(env, dict) else None
+
+
+def check_env(old: Dict[str, Any], new: Dict[str, Any],
+              allow_mismatch: bool = False) -> int:
+    """Backend honesty gate: records from DIFFERENT backends or device
+    counts are REFUSED, not silently diffed — the BENCH_r04-vs-r05
+    CPU/TPU confusion trap (a tunnel that quietly fell back to the CPU
+    proxy must never pass a gate calibrated on TPU numbers, nor vice
+    versa). ``--allow-env-mismatch`` downgrades the refusal to the old
+    loud warning for deliberate cross-backend reading. Softer stamps
+    (jax version, smoke flag) always warn only. Records carrying no
+    stamp on either side (pre-PR-2) compare as before."""
+    failures = 0
+    for k in ("backend", "device_count"):
+        ov, nv = _stamp(old, k), _stamp(new, k)
+        if ov is not None and nv is not None and ov != nv:
+            if allow_mismatch:
+                print(f"compare_bench: WARNING {k} mismatch "
+                      f"({ov!r} vs {nv!r}) overridden by "
+                      "--allow-env-mismatch — numbers are not "
+                      "apples-to-apples", file=sys.stderr)
+            else:
+                print(f"compare_bench: REFUSING to compare: {k} "
+                      f"{ov!r} (baseline) vs {nv!r} (candidate) — "
+                      "records from different backends measure "
+                      "different machines; pass --allow-env-mismatch "
+                      "to diff them anyway", file=sys.stderr)
+                failures += 1
     oenv, nenv = old.get("env"), new.get("env")
-    if not (isinstance(oenv, dict) and isinstance(nenv, dict)):
-        return
-    for k in ENV_KEYS:
-        if k in oenv and k in nenv and oenv[k] != nenv[k]:
-            print(f"compare_bench: WARNING env mismatch on {k!r}: "
-                  f"{oenv[k]!r} vs {nenv[k]!r} — numbers are not "
-                  "apples-to-apples", file=sys.stderr)
+    if isinstance(oenv, dict) and isinstance(nenv, dict):
+        for k in ENV_KEYS:
+            if k in ("backend", "device_count"):
+                continue  # hard-gated above
+            if k in oenv and k in nenv and oenv[k] != nenv[k]:
+                print(f"compare_bench: WARNING env mismatch on {k!r}: "
+                      f"{oenv[k]!r} vs {nenv[k]!r} — numbers are not "
+                      "apples-to-apples", file=sys.stderr)
+    return failures
 
 
 def check_steady_state(new: Dict[str, Any]) -> int:
@@ -342,6 +382,60 @@ def check_schedule(old: Dict[str, Any], new: Dict[str, Any]) -> int:
     return failures
 
 
+#: tolerated growth of the MEASURED serialized-collective fraction
+#: (trace captures are noisier than the static model: thread scheduling
+#: moves a few percent between runs; a real re-serialization moves the
+#: whole collective, i.e. tens of points)
+PHASE_PROFILE_FRACTION_TOL = 0.10
+
+
+def check_phase_profile(old: Dict[str, Any], new: Dict[str, Any]) -> int:
+    """The measured half of the overlap ratchet: the bench record embeds
+    the trace-parsed phase profile of the headline step
+    (``phase_profile``: per-phase measured ms, measured a2a fraction,
+    measured serialized-collective fraction, capture overhead,
+    measured-vs-modeled agreement). Three checks:
+
+    * any agreement violation in the candidate (a modeled-serialized
+      exchange that MEASURED overlapped, or a join failure) fails
+      outright — the cost model and the clock disagree;
+    * ``measured_serialized_fraction`` GROWING beyond
+      :data:`PHASE_PROFILE_FRACTION_TOL` fails — measured overlap, once
+      won by the pipelined step, can never silently regress (the
+      measured twin of :func:`check_schedule`'s modeled ratchet);
+    * a candidate missing the section while the baseline has it fails
+      (the capture crashed or was skipped — silence would hide exactly
+      the regressions the gate exists to catch).
+    """
+    sec = new.get("phase_profile")
+    if not isinstance(sec, dict):
+        if isinstance(old.get("phase_profile"), dict):
+            print("compare_bench: candidate record has no phase_profile "
+                  "section but the baseline does — the measured capture "
+                  "failed or was skipped; the measured overlap gate "
+                  "cannot run", file=sys.stderr)
+            return 1
+        return 0
+    failures = 0
+    for v in sec.get("violations") or []:
+        print(f"compare_bench: phase_profile agreement violation in the "
+              f"candidate record: {v}", file=sys.stderr)
+        failures += 1
+    osec = old.get("phase_profile")
+    if not isinstance(osec, dict):
+        return failures
+    of = osec.get("measured_serialized_fraction")
+    nf = sec.get("measured_serialized_fraction")
+    if isinstance(of, (int, float)) and isinstance(nf, (int, float)) \
+            and nf > of + PHASE_PROFILE_FRACTION_TOL:
+        print(f"compare_bench: phase_profile REGRESSION: measured "
+              f"serialized fraction {of:.3f} -> {nf:.3f} — an exchange "
+              "that used to measure hidden under compute is exposed "
+              "again on the clock", file=sys.stderr)
+        failures += 1
+    return failures
+
+
 #: streaming section contract: the capacity-bounded dynamic table must
 #: keep TRACKING the static-vocab AUC on the day-k/day-k+1 replay (and
 #: actually exercise its admission machinery) — the scenario's whole
@@ -389,6 +483,7 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
     steady_failures += check_phase_budget(old, new)
     steady_failures += check_plan_audit(old, new)
     steady_failures += check_schedule(old, new)
+    steady_failures += check_phase_profile(old, new)
     steady_failures += check_streaming(old, new)
     regressions = 0
     rows = []
@@ -434,11 +529,15 @@ def main(argv=None) -> int:
     ap.add_argument("--threshold", type=float, default=0.10,
                     help="max tolerated fractional regression "
                          "(default 0.10 = 10%%)")
+    ap.add_argument("--allow-env-mismatch", action="store_true",
+                    help="downgrade the cross-backend refusal to a "
+                         "warning (deliberate CPU-vs-TPU reading only)")
     args = ap.parse_args(argv)
     old, new = load_bench(args.old), load_bench(args.new)
     if old is None or new is None:
         return 2
-    check_env(old, new)
+    if check_env(old, new, allow_mismatch=args.allow_env_mismatch):
+        return 1
     return compare(old, new, args.threshold)
 
 

@@ -20,6 +20,7 @@ NAME = "module-scope-jax"
 SCOPE = ("distributed_embeddings_tpu/utils/obs.py",
          "distributed_embeddings_tpu/utils/runtime.py",
          "distributed_embeddings_tpu/utils/envvars.py",
+         "distributed_embeddings_tpu/utils/traceparse.py",
          "tools/compare_bench.py",
          "tools/detlint/**")
 

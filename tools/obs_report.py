@@ -17,12 +17,15 @@ Three ways in:
   and zero host callbacks in the audited jaxpr. Nonzero exit when any
   of that fails, so the target doubles as a gate.
 * ``python tools/obs_report.py --metrics BENCH.metrics.jsonl
-  [--telemetry run.telemetry.json]`` — fuse existing artifacts (a bench
-  sidecar, a resilient run's checkpoint-side telemetry flush) without
-  running anything.
+  [--telemetry run.telemetry.json] [--phases phase_profile.json]`` —
+  fuse existing artifacts (a bench sidecar, a resilient run's
+  checkpoint-side telemetry flush, a ``tools/phase_profile.py --json``
+  measured-phase artifact — or a raw ``DETPU_PROFILE_DIR`` trace
+  capture, parsed jax-free) without running anything.
 * ``python tools/obs_report.py --selftest`` (wired into ``make
-  verify``) — synthetic metrics JSONL + telemetry summary through the
-  full fusion + render path, no jax, sub-second.
+  verify``) — synthetic metrics JSONL + telemetry summary + the
+  checked-in miniature trace (``tests/data/mini.trace.json.gz``)
+  through the full fusion + render path, no jax, sub-second.
 
 Output: a human-readable report on stdout (``--json PATH`` for the
 machine-readable version): per-table top-k hot rows with Zipf-skew
@@ -137,11 +140,53 @@ def _flatten(v) -> List[float]:
 def fuse_report(metrics: Optional[Dict[str, Any]],
                 telemetry: Optional[Dict[str, Any]],
                 hbm: Optional[Dict[str, Any]],
-                verified: Optional[Dict[str, Any]] = None
+                verified: Optional[Dict[str, Any]] = None,
+                phases: Optional[List[Dict[str, Any]]] = None
                 ) -> Dict[str, Any]:
     """One observatory record from whichever inputs exist."""
     return {"metric": "obs_report", "metrics": metrics,
-            "telemetry": telemetry, "hbm": hbm, "verified": verified}
+            "telemetry": telemetry, "hbm": hbm, "verified": verified,
+            "phases": phases}
+
+
+def load_phases(path: str) -> List[Dict[str, Any]]:
+    """Measured-phase cases from either artifact shape:
+
+    * a ``tools/phase_profile.py --json`` dump (list of case records) —
+      passed through with calibration/violations intact;
+    * a raw trace capture — a ``DETPU_PROFILE_DIR`` directory or one
+      ``.trace.json[.gz]`` file — parsed with the jax-free
+      ``utils/traceparse.py`` (metadata-tier attribution only: no
+      compiled HLO to join bare names against) and reduced to the same
+      summary shape.
+    """
+    from distributed_embeddings_tpu.utils import traceparse
+
+    if os.path.isdir(path) or ".trace.json" in os.path.basename(path):
+        events = traceparse.parse_capture(path)
+        if not events:
+            raise ValueError(f"no op events parsed from trace {path!r}")
+        m = traceparse.measure_events(events)
+        return [{
+            "label": os.path.basename(path.rstrip(os.sep)),
+            "profile": {
+                "step_wall_ms_p50": m["wall_ms"],
+                "group_ms": m["group_ms"],
+                "a2a_frac": m["a2a_frac"],
+                "concurrency": m["concurrency"],
+                "measured_serialized_fraction":
+                    m["measured_serialized_fraction"],
+                "collectives": m["collectives"],
+                "resolved_frac": (m["events_resolved"] / m["events"]
+                                  if m["events"] else 0.0),
+            },
+            "phase_ms": {k: {"p50": v} for k, v in m["phase_ms"].items()},
+        }]
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        doc = [doc]
+    return doc
 
 
 def _fmt_bytes(n: Optional[float]) -> str:
@@ -222,6 +267,43 @@ def render(report: Dict[str, Any]) -> str:
                 f"   table {t['table_id']:>3}: ~{t['ids_per_step']} "
                 f"ids/step, est {_fmt_bytes(t['est_hbm_bytes_per_step'])}"
                 f"/step, {t['est_flops_per_step']} flops/step")
+    phases = report.get("phases")
+    if phases:
+        lines.append(f"-- measured phase profile ({len(phases)} case(s))")
+        for case in phases:
+            prof = case.get("profile") or {}
+            frac = prof.get("measured_serialized_fraction")
+            lines.append(
+                f"   {case.get('label', '?')}: wall p50 "
+                f"{prof.get('step_wall_ms_p50', 0):.2f} ms | a2a in "
+                f"flight {prof.get('a2a_frac', 0) * 100:.1f}% | "
+                f"concurrency x{prof.get('concurrency', 0):.2f} | "
+                "measured serialized frac "
+                + (f"{frac:.3f}" if isinstance(frac, (int, float))
+                   else "n/a"))
+            groups = prof.get("group_ms") or {}
+            if groups:
+                lines.append("      breakdown ms: " + " | ".join(
+                    f"{g} {groups[g]:.2f}" for g in
+                    ("exchange", "lookup", "dense", "apply", "streaming",
+                     "other") if g in groups))
+            for c in prof.get("collectives") or []:
+                lines.append(
+                    f"      {c['phase']}: {c['classification']} "
+                    f"(hidden {c.get('hidden_frac', 0) * 100:.0f}%)")
+            calib = case.get("calibration") or {}
+            flagged = calib.get("flagged")
+            if flagged is not None:
+                lines.append(
+                    f"      calibration: x"
+                    f"{calib.get('scale_measured_over_modeled', 0):.0f} "
+                    "backend scale, "
+                    + (f"{len(flagged)} phase(s) DRIFT beyond "
+                       f"{calib.get('drift_max')}x" if flagged
+                       else "no phase drifts beyond "
+                            f"{calib.get('drift_max')}x"))
+            for v in case.get("agreement_violations") or []:
+                lines.append(f"      VIOLATION: {v}")
     ver = report.get("verified")
     if ver:
         lines.append("-- verification")
@@ -399,9 +481,51 @@ def _synth_metrics(path: str, steps: int = 6, world: int = 8) -> None:
         }, step=s)
 
 
+#: the checked-in miniature TPU-style trace the no-jax selftest parses
+#: (2 device lanes, metadata-embedded op_names, one fused event, one
+#: event missing op_name, one host frame that must be dropped)
+MINI_TRACE = os.path.join(REPO, "tests", "data", "mini.trace.json.gz")
+
+
+def _selftest_phases() -> List[str]:
+    """Parse the checked-in miniature trace through the jax-free parser
+    and check the hand-computable numbers; returns failure strings."""
+    from distributed_embeddings_tpu.utils import traceparse
+
+    bad: List[str] = []
+    events = traceparse.parse_events(traceparse.load_trace(MINI_TRACE))
+    if len(events) != 8:  # 9 X events minus the $python host frame
+        bad.append(f"mini trace: expected 8 op events, got {len(events)}")
+    m = traceparse.measure_events(events)
+    want_phases = {
+        "embedding_forward/id_all_to_all",
+        "embedding_forward/lookup_w8_d/packed_gather",
+        "sparse_apply/sparse_apply_w8",
+    }
+    missing_ph = want_phases - set(m["phase_ms"])
+    if missing_ph:
+        bad.append(f"mini trace: phases not recovered: {missing_ph}")
+    # a2a spans [0,100)+[10,110) us -> union exactly 110 us
+    if abs(m["a2a_union_ms"] - 0.11) > 1e-9:
+        bad.append(f"mini trace: a2a union {m['a2a_union_ms']} != 0.11")
+    # compute in flight during the a2a: [50,60) copy + [95,110) of the
+    # pid2 gather/dot chain = 25 us -> serialized frac (110-25)/110
+    frac = m["measured_serialized_fraction"]
+    if frac is None or abs(frac - 85.0 / 110.0) > 1e-3:
+        bad.append(f"mini trace: serialized fraction {frac} != "
+                   f"{85.0 / 110.0:.4f}")
+    if m["events_resolved"] != 7:  # copy.3 carries no op_name anywhere
+        bad.append(f"mini trace: resolved {m['events_resolved']} != 7")
+    if not any(c["classification"] == "serialized"
+               for c in m["collectives"]):
+        bad.append("mini trace: a2a not classified serialized")
+    return bad
+
+
 def selftest() -> int:
-    """Synthetic metrics JSONL + telemetry summary -> full fusion +
-    render; asserts every report section materializes. No jax."""
+    """Synthetic metrics JSONL + telemetry summary + the checked-in
+    miniature trace -> full fusion + render; asserts every report
+    section materializes. No jax."""
     with tempfile.TemporaryDirectory(prefix="detpu_obs_report_") as tmp:
         side = os.path.join(tmp, "metrics.jsonl")
         _synth_metrics(side)
@@ -434,12 +558,14 @@ def selftest() -> int:
                                    "est_hbm_bytes_per_step": 12288,
                                    "est_flops_per_step": 4096}],
         }
+        phases = load_phases(MINI_TRACE)
         report = fuse_report(m, telemetry, hbm,
-                             {"selftest": True})
+                             {"selftest": True}, phases=phases)
         text = render(report)
         required = ("access telemetry", "step metrics", "HBM budget",
                     "imbalance ratio", "a2a bytes", "zipf", "slab w8",
-                    "compiled step")
+                    "compiled step", "measured phase profile",
+                    "id_all_to_all: serialized")
         missing = [r for r in required if r not in text]
         json.dumps(report)  # must round-trip
         if m is None or m["records"] != 6:
@@ -447,6 +573,7 @@ def selftest() -> int:
         # per-rank loads at step 0 are [140, 100 x7]: mean 105, max 140
         elif abs(m["imbalance_max"] - 140.0 / 105.0) > 1e-9:
             missing.append("imbalance math")
+        missing.extend(_selftest_phases())
         if missing:
             print(text)
             for x in missing:
@@ -454,7 +581,7 @@ def selftest() -> int:
                       file=sys.stderr)
             return 1
     print("obs_report selftest: OK (synthetic metrics + telemetry + HBM "
-          "budget fused and rendered)")
+          "budget + miniature measured trace fused and rendered)")
     return 0
 
 
@@ -465,6 +592,11 @@ def main(argv=None) -> int:
     ap.add_argument("--telemetry", metavar="PATH",
                     help="fuse an existing telemetry summary JSON (e.g. "
                          "a resilient run's <ckpt>.telemetry.json)")
+    ap.add_argument("--phases", metavar="PATH",
+                    help="fuse a measured phase-profile artifact: a "
+                         "tools/phase_profile.py --json dump, or a raw "
+                         "DETPU_PROFILE_DIR trace capture (dir or "
+                         ".trace.json[.gz] file, parsed jax-free)")
     ap.add_argument("--run", action="store_true",
                     help="force the live demo run even with --metrics")
     ap.add_argument("--world", type=int, default=DEMO_WORLD)
@@ -480,9 +612,9 @@ def main(argv=None) -> int:
     if args.selftest:
         return selftest()
 
-    if args.metrics or args.telemetry:
+    if args.metrics or args.telemetry or args.phases:
         if not args.run:
-            metrics = telemetry = None
+            metrics = telemetry = phases = None
             if args.metrics:
                 if not os.path.exists(args.metrics) and \
                         not os.path.exists(args.metrics + ".1"):
@@ -498,7 +630,15 @@ def main(argv=None) -> int:
                     print(f"obs_report: cannot read {args.telemetry}: {e}",
                           file=sys.stderr)
                     return 2
-            report = fuse_report(metrics, telemetry, None)
+            if args.phases:
+                try:
+                    phases = load_phases(args.phases)
+                except (OSError, ValueError,
+                        json.JSONDecodeError) as e:
+                    print(f"obs_report: cannot read {args.phases}: {e}",
+                          file=sys.stderr)
+                    return 2
+            report = fuse_report(metrics, telemetry, None, phases=phases)
             print(render(report))
             _maybe_json(report, args.json)
             return 0
