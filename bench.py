@@ -955,6 +955,39 @@ def run_phase_budget():
     }
 
 
+def _child_json(cmd_tail, timeout_s, label):
+    """Run one static-gate tool in a CHILD process pinned to the
+    virtual-device CPU backend (the audits and captures must never
+    touch — or wait on — this process's accelerator tunnel) and return
+    its ``--json`` payload. Shared by the ``schedule`` /
+    ``phase_profile`` / ``pipeline`` sections so the env pinning,
+    rc handling, and tempfile cleanup cannot drift apart."""
+    import subprocess
+    import tempfile
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    with tempfile.NamedTemporaryFile(
+            mode="r", suffix=".json", delete=False) as tf:
+        json_path = tf.name
+    try:
+        proc = subprocess.run(
+            [sys.executable] + cmd_tail + ["--json", json_path],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{label} rc={proc.returncode}: {proc.stderr[-500:]}")
+        with open(json_path, encoding="utf-8") as fh:
+            return json.load(fh), proc
+    finally:
+        try:
+            os.unlink(json_path)
+        except OSError:
+            pass
+
+
 def run_schedule():
     """Schedule-graph baseline of the compiled step (the overlap
     ratchet's anchor): runs ``tools/schedule_audit.py`` in a CHILD
@@ -966,38 +999,16 @@ def run_schedule():
     check_schedule`` fails any candidate whose fraction or critical-path
     bytes GROW versus the baseline — overlap, once won, can never
     silently regress. Smoke mode audits the headline (dense) case only;
-    full runs add the Criteo-1TB deployment shapes."""
-    import subprocess
-    import tempfile
-
-    cfgs = ["dense"] if SMOKE else ["dense", "criteo1tb"]
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)
+    full runs add the pipelined twin and the Criteo-1TB deployment
+    shapes."""
+    cfgs = ["dense"] if SMOKE else ["dense", "pipelined", "criteo1tb"]
     cases = {}
     violations = []
     for cfg in cfgs:
-        with tempfile.NamedTemporaryFile(
-                mode="r", suffix=".json", delete=False) as tf:
-            json_path = tf.name
-        try:
-            proc = subprocess.run(
-                [sys.executable,
-                 os.path.join("tools", "schedule_audit.py"),
-                 "--config", cfg, "--no-drill", "--json", json_path],
-                capture_output=True, text=True, timeout=600, env=env,
-                cwd=os.path.dirname(os.path.abspath(__file__)))
-            if proc.returncode != 0:
-                raise RuntimeError(
-                    f"schedule_audit --config {cfg} rc={proc.returncode}: "
-                    f"{proc.stderr[-500:]}")
-            with open(json_path, encoding="utf-8") as fh:
-                reports = json.load(fh)
-        finally:
-            try:
-                os.unlink(json_path)
-            except OSError:
-                pass
+        reports, _ = _child_json(
+            [os.path.join("tools", "schedule_audit.py"),
+             "--config", cfg, "--no-drill"],
+            600, f"schedule_audit --config {cfg}")
         for rep in reports:
             cases[rep["label"]] = {
                 "serialized_collective_fraction":
@@ -1010,10 +1021,16 @@ def run_schedule():
                      "on_critical_path": c["on_critical_path"]}
                     for c in rep["collectives"]
                     if c["op"] == "all-to-all"],
+                "violations": list(rep["violations"]),
             }
-            violations += rep["violations"]
+            # the pipelined case fails through its OWN section
+            # (schedule_pipelined) — folding its violations into the
+            # headline would fail the serialized gate for a pipelined
+            # defect and double-count the failure
+            if not rep["label"].startswith("pipelined"):
+                violations += rep["violations"]
     head = next(iter(cases.values()))
-    return {
+    out = {
         # headline (dense/world8) numbers — what check_schedule ratchets
         "serialized_collective_fraction":
             head["serialized_collective_fraction"],
@@ -1022,14 +1039,27 @@ def run_schedule():
         "cases": cases,
         "violations": violations,
     }
+    pip_label = next((k for k in cases if k.startswith("pipelined")),
+                     None)
+    if pip_label is not None:
+        # the pipelined twin lives ONLY in its own section
+        # (schedule_pipelined, ratcheted by a second check_schedule
+        # call): the K=2 step's modeled fraction (0.0 — every exchange
+        # overlappable) and critical path can never silently regress
+        # back toward the serialized baseline, and the headline section
+        # stays a function of the serialized cases alone
+        out["pipelined"] = dict(cases.pop(pip_label), label=pip_label)
+    return out
 
 
-def run_phase_profile():
+def run_phase_profile(case=None):
     """Measured phase-time baseline (the observatory's anchor): runs
     ``tools/phase_profile.py`` in a CHILD process pinned to the
     virtual-device CPU backend (profiling must never disturb — or wait
     on — this process's accelerator tunnel) and embeds the measured
-    report for the dense case: per-phase p50 ms, the measured
+    report for the dense case (``case="pipelined"`` measures the K=2
+    pipelined step instead — the ``phase_profile_pipelined`` section):
+    per-phase p50 ms, the measured
     exchange/lookup/apply/dense breakdown, measured a2a and serialized
     fractions, the capture overhead (profiling is strictly opt-in — the
     timed headline sections never pay it), and the calibration drift
@@ -1039,33 +1069,10 @@ def run_phase_profile():
     measured overlap, once the pipelined step (ROADMAP item 2) wins it,
     can never silently regress — or whose measured-vs-modeled
     classification disagrees."""
-    import subprocess
-    import tempfile
-
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)
-    with tempfile.NamedTemporaryFile(
-            mode="r", suffix=".json", delete=False) as tf:
-        json_path = tf.name
-    cmd = [sys.executable, os.path.join("tools", "phase_profile.py"),
-           "--json", json_path]
-    cmd += ["--smoke"] if SMOKE else ["--case", "dense"]
-    try:
-        proc = subprocess.run(
-            cmd, capture_output=True, text=True, timeout=900, env=env,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
-        if proc.returncode != 0:
-            raise RuntimeError(
-                f"phase_profile rc={proc.returncode}: "
-                f"{proc.stderr[-500:]}")
-        with open(json_path, encoding="utf-8") as fh:
-            records = json.load(fh)
-    finally:
-        try:
-            os.unlink(json_path)
-        except OSError:
-            pass
+    cmd = [os.path.join("tools", "phase_profile.py")]
+    cmd += (["--smoke"] if SMOKE and case is None
+            else ["--case", case or "dense"])
+    records, proc = _child_json(cmd, 900, "phase_profile")
     if not records:
         # rc can be 0 with zero cases when a capture failed non-strict;
         # an empty section must fail loudly, not ride the record hollow
@@ -1094,6 +1101,25 @@ def run_phase_profile():
         "violations": rec["agreement_violations"],
         "steps": rec["steps"],
     }
+
+
+def run_pipeline():
+    """Pipelined-vs-serialized step A/B (ROADMAP item 2's bench rider):
+    runs ``tools/pipeline_bench.py`` in a CHILD process pinned to the
+    world-8 virtual-device CPU mesh — the only topology this environment
+    exposes where the exchanges the pipeline hides actually exist (the
+    world-1 headline sections have no all-to-all) — and embeds both
+    ms/step figures, the speedup fraction, and the variant's own
+    steady-state recompile count (folded into the record-wide gate).
+    The throughput term is lifted top-level so ``tools/compare_bench.py``
+    ratchets it like any headline metric; the modeled/measured overlap
+    gates ride the ``schedule_pipelined`` / ``phase_profile_pipelined``
+    sections next to this one."""
+    global _STEADY_RECOMPILES
+    rec, _ = _child_json([os.path.join("tools", "pipeline_bench.py")],
+                         900, "pipeline_bench")
+    _STEADY_RECOMPILES += int(rec.get("steady_state_recompiles") or 0)
+    return rec
 
 
 def run_telemetry_overhead():
@@ -1615,13 +1641,32 @@ def main():
         # measured-vs-modeled classification disagrees (the measured
         # half of the overlap ratchet)
         out["phase_profile"] = pprof
+    if pprof is not None and not SMOKE:
+        # the measured twin of the pipelined step: trace-parsed per-phase
+        # ms + measured serialized fraction of the K=2 program, ratcheted
+        # as its own section by check_phase_profile (skipped when the
+        # dense capture already failed — its child would fail the same
+        # way, and the gate reads absence as "capture crashed")
+        pprof_pip = _guard("phase_profile_pipelined",
+                           lambda: run_phase_profile("pipelined"))
+        if pprof_pip is not None:
+            out["phase_profile_pipelined"] = pprof_pip
     sched = _guard("schedule", run_schedule)
     if sched is not None:
         # the dependency-DAG baseline rides the record so
         # tools/compare_bench.py can fail a candidate whose
         # serialized_collective_fraction or modeled critical-path bytes
         # grow (the overlap ratchet)
-        out["schedule"] = sched
+        out["schedule"] = {k: v for k, v in sched.items()
+                           if k != "pipelined"}
+        if "pipelined" in sched:
+            out["schedule_pipelined"] = sched["pipelined"]
+    pipe = None if SMOKE else _guard("pipeline", run_pipeline)
+    if pipe is not None:
+        # pipelined-vs-serialized wall clock on the world-8 CPU mesh;
+        # the throughput term is lifted so the regression gate sees it
+        out["pipeline"] = pipe
+        out["pipeline_samples_per_sec"] = pipe["pipeline_samples_per_sec"]
     telov = _guard("telemetry_overhead", run_telemetry_overhead)
     if telov is not None:
         out["telemetry_overhead"] = telov

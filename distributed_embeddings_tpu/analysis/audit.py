@@ -267,16 +267,27 @@ def _collect(jaxpr: jcore.Jaxpr, world: int):
 
 def expected_collectives(de: DistributedEmbedding, *,
                          nan_guard: bool,
-                         n_dense_leaves: int) -> Dict[str, Any]:
+                         n_dense_leaves: int,
+                         microbatches: Optional[int] = None
+                         ) -> Dict[str, Any]:
     """The communication contract for one hybrid train step on ``de``.
 
     * all_to_all — the paper's exchange structure: dp input runs the id
       exchange + output exchange forward and the cotangent exchange
       backward (2 fwd + 1 bwd); mp input (``dp_input=False``) skips the id
-      exchange (1 fwd + 1 bwd); a single worker runs none.
+      exchange (1 fwd + 1 bwd); a single worker runs none. A PIPELINED
+      schedule (``de.schedule.microbatches == K > 1``; override with
+      ``microbatches=``) runs each role once per microbatch — the
+      ``_mb{k}``-scoped instances still carry the role marker in their
+      scope, so the census buckets them correctly — and exactly K of
+      each is the contract: K+1 means a microbatch leaked an extra
+      exchange, K-1 means one got fused away with its batch semantics.
     * psum — what the data-parallel side owes: one loss ``pmean``, one
       ``pmean`` per dense-gradient leaf, plus the non-finite guard's
-      verdict ``pmean`` when the guard is built in.
+      verdict ``pmean`` when the guard is built in. K-INVARIANT: the
+      pipelined step accumulates locally and resolves once — a psum
+      count that grows with K is the per-microbatch-pmean regression
+      this contract exists to catch.
     * all_gather / reduce_scatter — never: the design's point is that NO
       slab-sized collective exists (an all_gather of the tables is the
       failure mode the paper's layout avoids).
@@ -284,12 +295,15 @@ def expected_collectives(de: DistributedEmbedding, *,
     if de.world_size <= 1:
         return {"all_to_all_roles": {}, "all_to_all": 0, "psum": 0,
                 "all_gather": 0, "reduce_scatter": 0}
+    if microbatches is None:
+        microbatches = int(getattr(de.schedule, "microbatches", 1) or 1)
+    k = max(int(microbatches), 1)
     roles = (["out_exchange_fwd", "grad_exchange_bwd"]
              if not de.dp_input else
              ["id_exchange_fwd", "out_exchange_fwd", "grad_exchange_bwd"])
     return {
-        "all_to_all_roles": {r: 1 for r in roles},
-        "all_to_all": len(roles),
+        "all_to_all_roles": {r: k for r in roles},
+        "all_to_all": len(roles) * k,
         "psum": 1 + n_dense_leaves + (1 if nan_guard else 0),
         "all_gather": 0,
         "reduce_scatter": 0,

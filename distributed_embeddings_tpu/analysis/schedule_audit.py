@@ -493,6 +493,25 @@ class ScheduleContract:
                 f"{self.expect!r}")
 
 
+def declared_overlap_contracts(schedule) -> List[ScheduleContract]:
+    """One ``expect="overlappable"`` contract per collective phase that
+    DECLARES an overlap — the expectations a pipelined (or streaming)
+    :class:`~..parallel.schedule.StepSchedule`'s claims imply. Running
+    these next to :meth:`ScheduleReport.check_against_schedule` makes
+    the gate two-sided: the declaration check verifies the claimed
+    partner compute exists, and these verify the collective's GLOBAL
+    classification flipped to overlappable (the serialized fraction the
+    bench ratchet rides)."""
+    out: List[ScheduleContract] = []
+    for p in schedule.phases:
+        if p.kind == "collective" and p.overlaps:
+            out.append(ScheduleContract(
+                p.name, expect="overlappable",
+                reason=f"schedule '{schedule.name}' declares overlap "
+                       f"with {list(p.overlaps)}"))
+    return out
+
+
 def baseline_contracts() -> List[ScheduleContract]:
     """The documented baseline of today's UNPIPELINED hybrid step: the
     id / out / grad all-to-alls exist, sit on the critical path, and are
@@ -536,12 +555,17 @@ class CollectiveInfo:
 
     def independent_matching(self, globs) -> float:
         """Independent compute attributable to phases matching any of
-        ``globs`` (full path or leaf, census convention)."""
+        ``globs`` — full path, leaf (census convention), or any single
+        path COMPONENT, so a declared partner phase owns its nested
+        sub-scopes (``embedding_forward_mb1/lookup_w4_d_mb1/
+        packed_gather`` counts toward a ``lookup_*_mb1`` claim: the
+        gather IS the lookup's compute)."""
         total = 0.0
         for phase, ns in self.independent_by_phase.items():
-            leaf = phase.rsplit("/", 1)[-1] if phase else ""
+            parts = phase.split("/") if phase else []
             if any(fnmatch.fnmatchcase(phase, g)
-                   or fnmatch.fnmatchcase(leaf, g) for g in globs):
+                   or any(fnmatch.fnmatchcase(p, g) for p in parts)
+                   for g in globs):
                 total += ns
         return total
 
@@ -893,6 +917,8 @@ def audit_train_step(de,
     to override either."""
     from .audit import build_abstract_step
 
+    from ..parallel.schedule import without_streaming
+
     step, args, _, _, _, _ = build_abstract_step(
         de, loss_fn, dense_tx, emb_optimizer, cat_inputs, batch,
         mesh=mesh, lr_schedule=lr_schedule, with_metrics=with_metrics,
@@ -900,6 +926,12 @@ def audit_train_step(de,
         dense_params=dense_params, state=state)
     if schedule is None:
         schedule = de.schedule
+        if dynamic is None or dynamic is False:
+            # a streaming-capable layer trained WITHOUT dynamic=
+            # executes the non-streaming program: its compiled DAG has
+            # no admission-staging nodes, so the streaming overlap
+            # declaration must not be checked against it
+            schedule = without_streaming(schedule)
     if contracts is None:
         contracts = baseline_contracts() if de.world_size > 1 else []
     return audit_step_fn(

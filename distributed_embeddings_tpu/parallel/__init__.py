@@ -40,6 +40,9 @@ from .schedule import (
     ScheduleError,
     StepSchedule,
     default_schedule,
+    pipelined_schedule,
+    resolve_schedule,
+    streaming_schedule,
 )
 from .streaming import (
     StreamingConfig,

@@ -104,7 +104,26 @@ def sparse_apply_gradients(de, params, opt_state, residuals, out_grads,
         opt_state = de.local_view(opt_state)
     if scale is None:
         scale = 1.0 / de.world_size
+    fallback = next(iter(params.values())).dtype
+    per_width = cotangent_width_streams(de, residuals, out_grads,
+                                        fallback_dtype=fallback)
+    return apply_width_streams(de, params, opt_state, per_width,
+                               optimizer, lr, scale, enable=enable)
 
+
+def cotangent_width_streams(de, residuals, out_grads, fallback_dtype=None,
+                            tag: str = ""):
+    """The sparse backward MINUS the optimizer scatter: route the output
+    cotangents through the reverse all-to-all and rebuild the per-width
+    ``(ids, update rows)`` streams from the forward residual. Split out
+    of :func:`sparse_apply_gradients` so the pipelined step can build
+    one stream set per microbatch (each behind its own
+    ``grad_all_to_all_mb{k}`` exchange, overlapping other microbatches'
+    dense compute) and MERGE them into the one
+    :func:`apply_width_streams` scatter per width slab — grad
+    accumulation across microbatches without a second pass over the
+    slabs. ``tag`` suffixes the exchange scope (empty = the serialized
+    step, byte-identical to the pre-split program)."""
     _, ids_recv, encs, b = residuals
     # single-worker no-combiner outputs keep their [b, h, w] rank
     # (reference call semantics); the exchange layout is flat columns
@@ -143,12 +162,11 @@ def sparse_apply_gradients(de, params, opt_state, residuals, out_grads,
     # Pack [world, b, s_max] in the plan's column layout and reverse the
     # output all-to-all (autodiff of the forward exchange would insert the
     # same collective; reference rides Horovod's registered alltoall grad).
-    out_dtype = (out_grads[0].dtype if out_grads
-                 else next(iter(params.values())).dtype)
+    out_dtype = (out_grads[0].dtype if out_grads else fallback_dtype)
     grads_by_worker = dict(zip(plan.instances, worker_grads))
     packed = exchange_mod.pack_grad_blocks(de, plan, grads_by_worker, b,
                                            out_dtype)
-    mp_grad = exchange_mod.exchange_grads(de, packed)
+    mp_grad = exchange_mod.exchange_grads(de, packed, tag=tag)
 
     # Rank-uniform sparse update: per group, rebuild the id stream from
     # the forward's residual block and expand slot cotangents to per-id
@@ -242,5 +260,4 @@ def sparse_apply_gradients(de, params, opt_state, residuals, out_grads,
         per_width.setdefault(_wkey(g.width), []).append(
             (ids, vals, g.width))
 
-    return apply_width_streams(de, params, opt_state, per_width,
-                               optimizer, lr, scale, enable=enable)
+    return per_width

@@ -82,7 +82,7 @@ from . import apply as apply_mod
 from . import exchange as exchange_mod
 from . import lookup as lookup_mod
 from . import plan as plan_mod
-from .schedule import default_schedule
+from . import schedule as schedule_mod
 from .strategy import DistEmbeddingStrategy
 
 EmbedParams = Dict[str, jax.Array]
@@ -219,6 +219,18 @@ class DistributedEmbedding:
         halving exchange bytes with bf16. Backward cotangents arrive in
         ``compute_dtype``, ride the reverse exchange, and are cast back up at
         the optimizer scatter. ``None`` keeps the parameter dtype end-to-end.
+      schedule: the :class:`~.schedule.StepSchedule` the trainer's hybrid
+        step executes and the schedule auditor certifies. ``None`` /
+        ``"serialized"`` (default) is the honest serialized baseline
+        (streaming layers declare their already-measured admission-staging
+        overlap); ``"pipelined"`` — or an explicit
+        :func:`~.schedule.pipelined_schedule` — opts into the K-microbatch
+        software-pipelined step (``DETPU_MICROBATCH`` resolves K for the
+        string form): the global batch splits into K chains inside one
+        jitted step so microbatch ``k+1``'s exchanges overlap microbatch
+        ``k``'s dense compute, with gradients accumulated so the applied
+        update matches the serialized step (K=1 is bitwise the serialized
+        program; the per-device batch must divide by K).
     """
 
     def __init__(self,
@@ -235,7 +247,8 @@ class DistributedEmbedding:
                  masked_reads: bool = False,
                  invalid_id_policy: str = "clamp",
                  ragged_overflow_raise: bool = False,
-                 table_loads: Optional[Sequence[float]] = None):
+                 table_loads: Optional[Sequence[float]] = None,
+                 schedule=None):
         if row_slice is not None and (isinstance(row_slice, bool)
                                       or not isinstance(row_slice, int)):
             # bool subclasses int: row_slice=True would silently mean
@@ -344,11 +357,16 @@ class DistributedEmbedding:
         self._plan_cache: Dict[tuple, plan_mod.ExchangePlan] = {}
         # the explicit step schedule the orchestrator runs and the
         # schedule auditor certifies (parallel/schedule.py): phase names,
-        # declared ordering, declared overlap. Today's default is the
-        # honest serialized baseline — every collective declares
-        # overlaps=() — which tools/schedule_audit.py verifies against
-        # the compiled program's dependency DAG.
-        self.schedule = default_schedule()
+        # declared ordering, declared overlap. The default is the honest
+        # serialized baseline — with the one overlap streaming programs
+        # ALREADY have (the admission-staging chain hides the out/grad
+        # exchanges) declared when dynamic tables exist, so
+        # tools/schedule_audit.py certifies it against the compiled DAG.
+        # schedule="pipelined" (or a pipelined_schedule(K)) opts the
+        # trainer into the K-microbatch latency-hiding step; K=1 and the
+        # default trace the bitwise-identical serialized program.
+        self.schedule = schedule_mod.resolve_schedule(
+            schedule, streaming=bool(self.streaming_tables))
 
     # ------------------------------------------------------------------ params
 
@@ -908,7 +926,7 @@ class DistributedEmbedding:
         return self.forward_with_residuals(params, inputs)[0]
 
     def forward_with_residuals(self, params: EmbedParams, inputs,
-                               streaming=None):
+                               streaming=None, phase_tag: str = ""):
         """Forward pass that also returns the routing residuals needed by
         :meth:`sparse_apply_gradients` (the manual sparse backward).
 
@@ -926,9 +944,22 @@ class DistributedEmbedding:
         element, the per-width ``pending`` dict the trainer hands to
         :func:`.streaming.commit` next to the nan-guard.
         ``(config, state, False)`` is the read-only form (eval): remap
-        only, no transitions, 2-tuple return. The residuals carry the
+        only, no transitions, 2-tuple return.
+        ``(config, state, "serve")`` is the pipelined trainer's
+        per-microbatch form: read-only remap (each microbatch's lookup
+        depends only on its own id exchange, never on the admission
+        staging) PLUS a third return element — the raw per-width
+        external-id :class:`~.streaming.WidthStream`\\ s of this call,
+        which the trainer concatenates across microbatches and hands to
+        :meth:`streaming_stage` for the ONE staging pass whose decisions
+        are bitwise the serialized step's. The residuals carry the
         REMAPPED block, so the sparse backward, step metrics, and
         telemetry all operate on in-range internal rows.
+
+        ``phase_tag`` suffixes every phase scope of this forward (the
+        pipelined step's ``_mb{k}`` microbatch instances); empty (the
+        default) leaves the serialized program's scopes — and therefore
+        its compiled text — byte-identical to before.
         """
         params = self.local_view(params)
 
@@ -953,13 +984,14 @@ class DistributedEmbedding:
             ids_recv = exchange_mod.build_send_blocks(self, plan, entries,
                                                       comm_dtype)
             ids_recv, spending = self._streaming_remap(plan, ids_recv,
-                                                       streaming)
+                                                       streaming,
+                                                       tag=phase_tag)
             # slot-major group outputs: per-instance outputs are plain
             # slices, skipping the exchange-row transpose the single
             # worker never needs (only multi-slot instances pay a small
             # per-instance transpose)
             reds = lookup_mod.plan_lookup_groups(self, plan, params,
-                                                 ids_recv)
+                                                 ids_recv, tag=phase_tag)
             outs = []
             for inst in plan.instances:  # worker order == input order here
                 g = plan.groups[inst.group]
@@ -1008,7 +1040,7 @@ class DistributedEmbedding:
             # --- dp -> mp id exchange (schedule phase "id_all_to_all",
             # parallel/exchange.py) -----------------------------------------
             ids_recv = exchange_mod.exchange_ids(self, plan, entries,
-                                                 comm_dtype)
+                                                 comm_dtype, tag=phase_tag)
         else:
             # --- model-parallel input: this rank already holds the global
             # batch of ids for its local tables; no id exchange runs
@@ -1037,16 +1069,17 @@ class DistributedEmbedding:
                 ids_recv = ids_recv.astype(jnp.int32)
 
         # --- streaming remap (dynamic-vocab tables) ------------------------
-        ids_recv, spending = self._streaming_remap(plan, ids_recv, streaming)
+        ids_recv, spending = self._streaming_remap(plan, ids_recv, streaming,
+                                                   tag=phase_tag)
 
         # --- rank-uniform local lookup (schedule phase family
         # "lookup_*", parallel/lookup.py) -----------------------------------
-        mp_out = lookup_mod.plan_lookup(self, plan, params,
-                                        ids_recv)  # [world, b, s_max]
+        mp_out = lookup_mod.plan_lookup(self, plan, params, ids_recv,
+                                        tag=phase_tag)  # [world, b, s_max]
 
         # --- mp -> dp output exchange (schedule phase "out_all_to_all",
         # parallel/exchange.py) ---------------------------------------------
-        dp_recv = exchange_mod.exchange_outputs(self, mp_out)
+        dp_recv = exchange_mod.exchange_outputs(self, mp_out, tag=phase_tag)
         # dp_recv[r] = this rank's batch as computed by source rank r.
 
         # --- unpack (static slices), reorder, concat column slices ---------
@@ -1273,7 +1306,13 @@ class DistributedEmbedding:
         Args:
           tstate: this device's telemetry state
             (:func:`~..analysis.telemetry.local_state` view).
-          residuals: second output of :meth:`forward_with_residuals`.
+          residuals: second output of :meth:`forward_with_residuals` —
+            or a LIST of them (the pipelined step's per-microbatch
+            residuals): the per-width id streams of every residual
+            concatenate into ONE sketch fold and ONE top-k merge, so the
+            counted traffic matches the serialized step's (the count-min
+            scatter-add is associative; a per-microbatch fold would
+            merge candidates against partially-folded estimates).
           config: a :class:`~..analysis.telemetry.TelemetryConfig`
             (trace-time static).
 
@@ -1282,48 +1321,53 @@ class DistributedEmbedding:
         """
         from ..analysis import telemetry as tel
 
-        _, ids_recv, encs, b = residuals
-        plan = self._get_plan(list(encs), b)
+        res_list = ([residuals] if residuals and residuals[0] == "dist"
+                    else list(residuals))
         world = self.world_size
         my = self._my_rank()
         per_width: Dict[int, tuple] = {}
-        for gi, g in enumerate(plan.groups):
-            with obs.scope(f"telemetry_w{g.width}_{g.kind}"):
-                region = lax.slice(ids_recv, (0, g.goff),
-                                   (world, g.goff + g.n * g.blen))
-                rows = self._plan_row(plan.rows[gi], my)
-                roff = self._plan_row(plan.roff[gi], my)
-                slot_ok = self._plan_row(plan.valid[gi], my) > 0
-                rbase = (self._plan_row(plan.rbase[gi], my)
-                         if plan.rsliced[gi].any() else None)
-                if g.kind == "d":
-                    ids = region.reshape(world, g.n, b, g.hot)
-                    loc = (ids - rbase[None, :, None, None]
-                           if rbase is not None else ids)
-                    # live = in-range on THIS slot: row-sliced slots count
-                    # each id on exactly the slice that owns it, dead and
-                    # out-of-vocab ids drop (they train nothing either)
-                    live = ((loc >= 0)
-                            & (loc < rows[None, :, None, None])
-                            & slot_ok[None, :, None, None])
-                    grow = loc + roff[None, :, None, None]
-                else:
-                    r3 = region.reshape(world, g.n, g.blen)
-                    values = r3[:, :, :g.hot]
-                    lengths = r3[:, :, g.hot:g.hot + b]
-                    tot = jnp.sum(lengths, axis=2, dtype=jnp.int32)
-                    pos_live = (
-                        jnp.arange(g.hot, dtype=jnp.int32)[None, None, :]
-                        < jnp.minimum(tot, g.hot)[:, :, None])
-                    loc = (values - rbase[None, :, None]
-                           if rbase is not None else values)
-                    live = (pos_live & (loc >= 0)
-                            & (loc < rows[None, :, None])
-                            & slot_ok[None, :, None])
-                    grow = loc + roff[None, :, None]
-                acc = per_width.setdefault(g.width, ([], []))
-                acc[0].append(grow.astype(jnp.int32).reshape(-1))
-                acc[1].append(live.reshape(-1))
+        for residuals in res_list:
+            _, ids_recv, encs, b = residuals
+            plan = self._get_plan(list(encs), b)
+            for gi, g in enumerate(plan.groups):
+                with obs.scope(f"telemetry_w{g.width}_{g.kind}"):
+                    region = lax.slice(ids_recv, (0, g.goff),
+                                       (world, g.goff + g.n * g.blen))
+                    rows = self._plan_row(plan.rows[gi], my)
+                    roff = self._plan_row(plan.roff[gi], my)
+                    slot_ok = self._plan_row(plan.valid[gi], my) > 0
+                    rbase = (self._plan_row(plan.rbase[gi], my)
+                             if plan.rsliced[gi].any() else None)
+                    if g.kind == "d":
+                        ids = region.reshape(world, g.n, b, g.hot)
+                        loc = (ids - rbase[None, :, None, None]
+                               if rbase is not None else ids)
+                        # live = in-range on THIS slot: row-sliced slots
+                        # count each id on exactly the slice that owns
+                        # it, dead and out-of-vocab ids drop (they train
+                        # nothing either)
+                        live = ((loc >= 0)
+                                & (loc < rows[None, :, None, None])
+                                & slot_ok[None, :, None, None])
+                        grow = loc + roff[None, :, None, None]
+                    else:
+                        r3 = region.reshape(world, g.n, g.blen)
+                        values = r3[:, :, :g.hot]
+                        lengths = r3[:, :, g.hot:g.hot + b]
+                        tot = jnp.sum(lengths, axis=2, dtype=jnp.int32)
+                        pos_live = (
+                            jnp.arange(g.hot, dtype=jnp.int32)[None, None,
+                                                               :]
+                            < jnp.minimum(tot, g.hot)[:, :, None])
+                        loc = (values - rbase[None, :, None]
+                               if rbase is not None else values)
+                        live = (pos_live & (loc >= 0)
+                                & (loc < rows[None, :, None])
+                                & slot_ok[None, :, None])
+                        grow = loc + roff[None, :, None]
+                    acc = per_width.setdefault(g.width, ([], []))
+                    acc[0].append(grow.astype(jnp.int32).reshape(-1))
+                    acc[1].append(live.reshape(-1))
         new = dict(tstate)
         total = jnp.zeros((1,), jnp.float32)
         for w in sorted(per_width):
@@ -1371,20 +1415,25 @@ class DistributedEmbedding:
         self._streaming_arrays_cache[key] = out
         return out
 
-    def _streaming_remap(self, plan, ids_recv, streaming):
+    def _streaming_remap(self, plan, ids_recv, streaming, tag: str = ""):
         """Remap every streaming-table slot's external ids in the
         received block through the jit-carried slot map
         (:func:`.streaming.remap_width`) and, in update mode, stage the
         admission/eviction transitions.
 
         ``streaming`` is ``None`` (no-op), ``(config, state)`` (train:
-        remap + stage), or ``(config, state, False)`` (read-only remap —
-        the eval path admits nothing). Returns ``(ids_recv, pending)``
+        remap + stage), ``(config, state, False)`` (read-only remap —
+        the eval path admits nothing), or ``(config, state, "serve")``
+        (the pipelined per-microbatch form: read-only remap that ALSO
+        returns this call's raw per-width external-id streams, under
+        ``streaming_serve_w{w}{tag}`` scopes so each microbatch's serve
+        chain stays a distinct phase). Returns ``(ids_recv, pending)``
         with ``pending`` a ``{width: (new_wstate, scrub_rows, stats)}``
-        dict in update mode, else ``None``. Pure jax on tensors the step
-        already holds; static shapes throughout (0 steady-state
-        recompiles); only the modified group regions are rewritten
-        (static-offset ``dynamic_update_slice``)."""
+        dict in update mode, a ``{width: WidthStream}`` dict in serve
+        mode (feed :meth:`streaming_stage`), else ``None``. Pure jax on
+        tensors the step already holds; static shapes throughout (0
+        steady-state recompiles); only the modified group regions are
+        rewritten (static-offset ``dynamic_update_slice``)."""
         if streaming is None:
             return ids_recv, None
         from . import streaming as streaming_mod
@@ -1398,6 +1447,9 @@ class DistributedEmbedding:
             update = True
         else:
             config, sstate, update = streaming
+        serve = update == "serve"
+        if serve:
+            update = False
         arrays = self._streaming_plan_arrays(plan)
         world = self.world_size
         my = self._my_rank()
@@ -1409,7 +1461,7 @@ class DistributedEmbedding:
             dyn_a, cap_a, nb_a, tid_a = arrays[gi]
             if not dyn_a.any():
                 continue
-            with obs.scope(f"streaming_remap_w{g.width}_{g.kind}"):
+            with obs.scope(f"streaming_remap_w{g.width}_{g.kind}{tag}"):
                 region = lax.slice(ids_recv, (0, g.goff),
                                    (world, g.goff + g.n * g.blen))
                 dyn = self._plan_row(dyn_a, my)
@@ -1460,12 +1512,20 @@ class DistributedEmbedding:
                 nbuckets=jnp.concatenate([p[3] for p in pieces]),
                 tid=jnp.concatenate([p[4] for p in pieces]),
                 roff=jnp.concatenate([p[5] for p in pieces]))
-            with obs.scope(f"streaming_admit_w{w}"):
+            # the serve half runs under its own (per-microbatch) phase in
+            # pipelined steps — it feeds this microbatch's lookup, so it
+            # must never share the staging phase the schedule declares
+            # independent of the out/grad exchanges
+            scope_name = (f"streaming_serve_w{w}{tag}" if serve
+                          else f"streaming_admit_w{w}")
+            with obs.scope(scope_name):
                 local_rows, pend = streaming_mod.remap_width(
                     sstate[_wkey(w)], stream, self.rows_cap[w], config,
                     update=update)
             remapped[w] = local_rows
-            if pend is not None:
+            if serve:
+                pending[w] = stream
+            elif pend is not None:
                 pending[w] = pend
 
         for gi, w, start, vals, dynm, tail in sites:
@@ -1484,7 +1544,37 @@ class DistributedEmbedding:
                     [new_vals, tail], axis=2).reshape(world, g.n * g.blen)
             ids_recv = lax.dynamic_update_slice(ids_recv, region_new,
                                                 (0, g.goff))
-        return ids_recv, (pending if update else None)
+        return ids_recv, (pending if (update or serve) else None)
+
+    def streaming_stage(self, width_streams, config, sstate):
+        """The pipelined step's ONE admission-staging pass: concatenate
+        the per-microbatch raw external-id streams (the ``"serve"``-mode
+        third return of :meth:`forward_with_residuals`, one dict per
+        microbatch) and run :func:`.streaming.remap_width` in update
+        mode over the combined stream — exactly the serialized step's
+        staging input, so the sketch fold, admission estimates, and
+        deterministic claim resolution are BITWISE the serialized
+        decisions (the max-scatter tie-breaks are order-independent for
+        duplicate ids, and the count-min fold is a plain scatter-add).
+        Runs under the same ``streaming_admit_w{w}`` scopes as the
+        serialized staging, so the schedule's declared overlap names one
+        phase in both programs. Returns the ``pending`` dict
+        :func:`.streaming.commit` consumes."""
+        from . import streaming as streaming_mod
+
+        widths = sorted({w for ws in width_streams for w in ws})
+        pending: Dict[int, tuple] = {}
+        for w in widths:
+            parts = [ws[w] for ws in width_streams if w in ws]
+            stream = streaming_mod.WidthStream(
+                *(jnp.concatenate([getattr(p, f) for p in parts])
+                  for f in streaming_mod.WidthStream._fields))
+            with obs.scope(f"streaming_admit_w{w}"):
+                _, pend = streaming_mod.remap_width(
+                    sstate[_wkey(w)], stream, self.rows_cap[w], config,
+                    update=True)
+            pending[w] = pend
+        return pending
 
     # ------------------------------------------------------------- checkpoint
 

@@ -110,24 +110,27 @@ def build_send_blocks(de, plan, entries, comm_dtype) -> jax.Array:
         full_shape=(plan.l_max,), dtype=comm_dtype, axis=0)
 
 
-def exchange_ids(de, plan, entries, comm_dtype) -> jax.Array:
+def exchange_ids(de, plan, entries, comm_dtype, tag: str = "") -> jax.Array:
     """The dp→mp id exchange (schedule phase
     :data:`~.schedule.PHASE_ID_EXCHANGE`): assemble the send blocks and
     run the tiled all-to-all. Blocks use the rank-uniform group-region
     layout (``parallel/plan.py``); the reference pads to the max
     per-rank split instead (``dist_model_parallel.py:273-282``) — same
     idea, but static regions let the lookup run without per-rank
-    branches."""
-    with obs.scope(schedule_mod.PHASE_ID_EXCHANGE):
+    branches. ``tag`` suffixes the phase scope (the pipelined step's
+    ``_mb{k}`` microbatch instances; empty for the serialized step, so
+    its program text is byte-identical to before)."""
+    with obs.scope(schedule_mod.PHASE_ID_EXCHANGE + tag):
         ids_send = build_send_blocks(de, plan, entries, comm_dtype)
         return lax.all_to_all(ids_send, de.axis_name, 0, 0, tiled=True)
 
 
-def exchange_outputs(de, mp_out: jax.Array) -> jax.Array:
+def exchange_outputs(de, mp_out: jax.Array, tag: str = "") -> jax.Array:
     """The mp→dp activation exchange (schedule phase
     :data:`~.schedule.PHASE_OUT_EXCHANGE`): ``dp_recv[r]`` is this
-    rank's batch as computed by source rank ``r``."""
-    with obs.scope(schedule_mod.PHASE_OUT_EXCHANGE):
+    rank's batch as computed by source rank ``r``. ``tag`` as in
+    :func:`exchange_ids`."""
+    with obs.scope(schedule_mod.PHASE_OUT_EXCHANGE + tag):
         return lax.all_to_all(mp_out, de.axis_name, 0, 0, tiled=True)
 
 
@@ -145,13 +148,14 @@ def pack_grad_blocks(de, plan, grads_by_worker, b: int,
         axis=1)  # [world, b, s_max]
 
 
-def exchange_grads(de, packed: jax.Array) -> jax.Array:
+def exchange_grads(de, packed: jax.Array, tag: str = "") -> jax.Array:
     """The reverse cotangent exchange (schedule phase
     :data:`~.schedule.PHASE_GRAD_EXCHANGE`): autodiff of the forward
     exchange would insert the same collective; the reference rides
     Horovod's registered alltoall grad. World 1 is a passthrough (the
-    packed block already is this worker's)."""
-    with obs.scope(schedule_mod.PHASE_GRAD_EXCHANGE):
+    packed block already is this worker's). ``tag`` as in
+    :func:`exchange_ids`."""
+    with obs.scope(schedule_mod.PHASE_GRAD_EXCHANGE + tag):
         return (lax.all_to_all(packed, de.axis_name, 0, 0, tiled=True)
                 if de.world_size > 1 else packed)
 

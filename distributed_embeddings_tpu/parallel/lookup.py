@@ -96,11 +96,12 @@ def ragged_scatter_idx(g, b: int, world: int, seg) -> jax.Array:
     return (s_ix * g.n + f_ix) * (b + 1) + seg
 
 
-def plan_lookup(de, plan, params, ids_recv) -> jax.Array:
+def plan_lookup(de, plan, params, ids_recv, tag: str = "") -> jax.Array:
     """All local lookups in exchange-row layout ``[world, b, s_max]``
     (``compute_dtype`` — the pre-comm mixed-precision cast, reference
     ``dist_model_parallel.py:300``). Dead slots produce garbage columns
-    that no consumer ever slices."""
+    that no consumer ever slices. ``tag`` suffixes the group scopes
+    (the pipelined step's ``_mb{k}`` instances; empty = serialized)."""
     world = de.world_size
     b = plan.b
     # plan_lookup_groups already casts to compute_dtype; only the
@@ -109,12 +110,14 @@ def plan_lookup(de, plan, params, ids_recv) -> jax.Array:
            or next(iter(params.values())).dtype)
     sections = [
         red.transpose(0, 2, 1, 3).reshape(world, b, -1)
-        for red in plan_lookup_groups(de, plan, params, ids_recv)]
+        for red in plan_lookup_groups(de, plan, params, ids_recv,
+                                      tag=tag)]
     return (jnp.concatenate(sections, axis=2) if sections
             else de._vary(jnp.zeros((world, b, plan.s_max), zdt)))
 
 
-def plan_lookup_groups(de, plan, params, ids_recv) -> List[jax.Array]:
+def plan_lookup_groups(de, plan, params, ids_recv,
+                       tag: str = "") -> List[jax.Array]:
     """Per-group combined lookups in slot-major ``[world, n, b, width]``
     layout: one region reshape, one slab gather, one combine per group.
     The single-worker forward consumes these directly (its per-instance
@@ -127,7 +130,7 @@ def plan_lookup_groups(de, plan, params, ids_recv) -> List[jax.Array]:
     for gi, g in enumerate(plan.groups):
         # one named scope per (width, kind) group: a profile of the
         # step attributes gather/combine time to the width it serves
-        with obs.scope(f"lookup_w{g.width}_{g.kind}"):
+        with obs.scope(f"lookup_w{g.width}_{g.kind}{tag}"):
             red = lookup_group(de, plan, gi, g, params[_wkey(g.width)],
                                ids_recv, my, plan.b)
         dt = de.compute_dtype

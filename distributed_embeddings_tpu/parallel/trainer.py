@@ -25,6 +25,8 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..utils import obs
+from . import apply as apply_mod
+from . import schedule as schedule_mod
 from .dist_embedding import DistributedEmbedding
 from .grads import resolve_dp_gradient
 
@@ -104,6 +106,322 @@ def _table_sentinels(de, out_grads, lr):
     }
 
 
+def _microbatch_count(de) -> int:
+    """The schedule-declared microbatch count the step builders split
+    by (1 = the serialized program, traced through the exact pre-
+    pipelining code path)."""
+    return int(getattr(de.schedule, "microbatches", 1) or 1)
+
+
+def _microbatch_inputs(cat_inputs, batch, K: int):
+    """Split one per-device batch into K microbatch slices along the
+    leading batch dimension: ``[(cat_inputs_k, batch_k), ...]``.
+
+    Dense categorical inputs and every ``batch`` pytree leaf slice rows
+    ``[k*b/K, (k+1)*b/K)``. A :class:`~...ops.embedding_lookup.Ragged`
+    keeps its FULL static capacity per microbatch (the id count per row
+    is dynamic, so a smaller static capacity could truncate a skewed
+    microbatch): values gather from the CSR offset of the microbatch's
+    first row, row_splits rebase to 0. A COO
+    :class:`~...ops.embedding_lookup.SparseIds` converts to CSR first —
+    the same conversion the forward's input normalization applies.
+    ``b % K != 0`` raises at trace time (unequal microbatches would
+    break the exact mean-of-means loss accumulation)."""
+    from ..ops.embedding_lookup import Ragged, SparseIds, row_to_split
+
+    def norm(x):
+        if isinstance(x, SparseIds):
+            return Ragged(values=x.values,
+                          row_splits=row_to_split(x.indices,
+                                                  x.dense_shape[0]),
+                          weights=x.weights)
+        return x
+
+    cats = [norm(c) for c in cat_inputs]
+
+    def rows_of(x):
+        return x.nrows if isinstance(x, Ragged) else x.shape[0]
+
+    if cats:
+        b = rows_of(cats[0])
+    else:
+        b = jax.tree_util.tree_leaves(batch)[0].shape[0]
+    if b % K:
+        raise ValueError(
+            f"pipelined step: per-device batch {b} does not divide into "
+            f"{K} microbatches — pick K | batch (DETPU_MICROBATCH / the "
+            "pipelined_schedule argument)")
+    mbb = b // K
+
+    def slice_cat(x, k):
+        if isinstance(x, Ragged):
+            splits = x.row_splits
+            lo = splits[k * mbb]
+            sub = lax.slice_in_dim(splits, k * mbb, (k + 1) * mbb + 1,
+                                   axis=0) - lo
+            cap = x.values.shape[0]
+            idx = lo + jnp.arange(cap, dtype=splits.dtype)
+            vals = jnp.take(x.values, idx, mode="clip")
+            wts = (jnp.take(x.weights, idx, mode="clip")
+                   if x.weights is not None else None)
+            return Ragged(values=vals, row_splits=sub, weights=wts)
+        return lax.slice_in_dim(x, k * mbb, (k + 1) * mbb, axis=0)
+
+    out = []
+    for k in range(K):
+        cats_k = [slice_cat(c, k) for c in cats]
+        batch_k = jax.tree.map(
+            lambda a, k=k: lax.slice_in_dim(a, k * mbb, (k + 1) * mbb,
+                                            axis=0), batch)
+        out.append((cats_k, batch_k))
+    return out
+
+
+def _apply_dense_and_assemble(de, state, emb_local, emb_opt_local,
+                              new_emb, new_emb_opt, dense_grads,
+                              dense_tx, ok, nan_guard):
+    """Shared step epilogue of the serialized and pipelined bodies: the
+    dense optimizer update, the non-finite guard's small-leaf
+    where-selects, and the new-state assembly — ONE body so the guard's
+    skip semantics can never drift between the two step variants.
+
+    Slab-shaped leaves are already protected by the sentinel-gated
+    scatters; only the small leaves need an explicit select — the dense
+    params/opt state (MBs) and non-slab embedding-optimizer aux (Adam's
+    step count), never the GB-scale slabs."""
+    with obs.scope("dense_update"):
+        updates, dense_opt_state = dense_tx.update(
+            dense_grads, state.dense_opt_state, state.dense_params)
+        dense_params = optax.apply_updates(state.dense_params, updates)
+
+    if nan_guard:
+        slab_shapes = {tuple(v.shape) for v in emb_local.values()}
+
+        def sel(new, old):
+            return jax.tree.map(lambda n, o: jnp.where(ok, n, o), new, old)
+
+        new_emb_opt = jax.tree.map(
+            lambda n, o: (n if tuple(n.shape) in slab_shapes
+                          else jnp.where(ok, n, o)),
+            new_emb_opt, emb_opt_local)
+        dense_params = sel(dense_params, state.dense_params)
+        dense_opt_state = sel(dense_opt_state, state.dense_opt_state)
+
+    return HybridTrainState(
+        emb_params=de.stacked_view(new_emb),
+        emb_opt_state=de.stacked_view(new_emb_opt),
+        dense_params=dense_params, dense_opt_state=dense_opt_state,
+        step=state.step + 1)
+
+
+def _finish_metrics(de, metrics, out_grads, dense_grads, loss, ok, state,
+                    sstats, lr):
+    """Shared tail of the instrumented step's metrics dict (sentinels,
+    norms, loss/step/skip counters, ``stream_*`` stats) — the pipelined
+    step passes the exactly-reassembled full-batch cotangents so every
+    entry keeps serialized semantics."""
+    with obs.scope("health_sentinels"):
+        # per-table numerical health, next to the nan-guard: names WHICH
+        # table's cotangents went non-finite/exploded (the recovery log's
+        # "table 3 went unhealthy at step k", not just "step k skipped")
+        metrics.update(_table_sentinels(de, out_grads, lr))
+    # out_grads are device-varying; the pmean'd loss / resolved dense
+    # grads / replicated step are not — _vary marks them for P(axis) out
+    metrics["emb_grad_norm"] = jnp.sqrt(_sq_sum(out_grads)).reshape(1)
+    metrics["dense_grad_norm"] = de._vary(
+        jnp.sqrt(_sq_sum(dense_grads)).reshape(1))
+    metrics["loss"] = de._vary(loss.astype(jnp.float32).reshape(1))
+    skipped = ((1 - ok.astype(jnp.int32)).reshape(1) if ok is not None
+               else jnp.zeros((1,), jnp.int32))
+    metrics["skipped_steps"] = de._vary(skipped)
+    metrics["step"] = de._vary(state.step.astype(jnp.int32).reshape(1))
+    if sstats is not None:
+        # this step's (guard-gated) slot-map transition counts — derived
+        # from the device-varying routed ids, so P(axis) stacks them per
+        # rank like every other metric
+        for k, v in sstats.items():
+            metrics[f"stream_{k}"] = v
+    return metrics
+
+
+def _pipelined_local_step(de, loss_fn, dense_tx, emb_optimizer,
+                          lr_schedule, state, cat_inputs, batch, K,
+                          with_metrics=False, nan_guard=False,
+                          telemetry_cfg=None, telem=None,
+                          streaming_cfg=None, sstate=None):
+    """The K-microbatch software-pipelined hybrid step (ROADMAP item 2;
+    built when ``de.schedule`` is a :func:`~.schedule.pipelined_schedule`
+    with K > 1 — K == 1 never reaches here, it traces the serialized
+    program bitwise).
+
+    The per-device batch splits into K microbatches; each runs its own
+    id-exchange → lookup → out-exchange → dense fwd/bwd chain under
+    ``_mb{k}``-suffixed phase scopes. The chains share NO data
+    dependencies until the accumulation point — all microbatches read
+    the same parameters, gradients accumulate, ONE dense update and ONE
+    sparse apply per width slab run at the end — so XLA's scheduler is
+    free to ship microbatch k+1's all-to-alls while microbatch k's
+    dense compute runs (the overlap the schedule declares and
+    ``make schedule-audit`` / ``make phase-profile`` certify).
+
+    Numerics vs the serialized step: the accumulation leans on the step
+    builders' documented ``loss_fn`` contract — a *plain (unweighted)
+    mean* over the per-device batch shard. Under that contract each
+    microbatch loss is a mean over b/K rows, so per-row cotangents are
+    K× the full-batch ones and the 1/K accumulation scale restores them
+    exactly for power-of-two K. A loss that is NOT an unweighted mean —
+    a sum reduction, or a masked/weighted mean whose denominator varies
+    per row subset — violates that contract and silently trains a
+    different trajectory under K > 1 (mean-of-means ≠ overall mean);
+    keep such losses on the serialized schedule or fold the weighting
+    into per-row terms of an unweighted mean. Dense gradients average across microbatches (one pmean per leaf,
+    after accumulation — the psum census is K-invariant), the sparse
+    apply concatenates the per-microbatch update streams into the same
+    single scatter per width slab, and streaming admission stages ONCE
+    over the concatenated raw id streams (bitwise the serialized
+    decisions — :meth:`~.dist_embedding.DistributedEmbedding
+    .streaming_stage`). K > 1 trajectories are float-rounding-
+    equivalent, not bitwise: the scatter-add accumulation order over
+    duplicate ids differs (microbatch-major instead of batch-major).
+    """
+    world = de.world_size
+    if not de.dp_input:
+        raise NotImplementedError(
+            "pipelined schedules need dp inputs: mp-input mode has no id "
+            "exchange to hide (use dp_input=True or a serialized "
+            "schedule)")
+    emb_local = de.local_view(state.emb_params)
+    emb_opt_local = de.local_view(state.emb_opt_state)
+    mbs = _microbatch_inputs(cat_inputs, batch, K)
+
+    losses = []
+    dense_grads_list = []
+    out_grads_list = []
+    res_list = []
+    serve_list = []
+    for k, (cats_k, batch_k) in enumerate(mbs):
+        tag = schedule_mod.microbatch_tag(k)
+        with obs.scope(f"embedding_forward{tag}"):
+            if streaming_cfg is not None:
+                outs, res, serve = de.forward_with_residuals(
+                    emb_local, cats_k,
+                    streaming=(streaming_cfg, sstate, "serve"),
+                    phase_tag=tag)
+                serve_list.append(serve)
+            else:
+                outs, res = de.forward_with_residuals(emb_local, cats_k,
+                                                      phase_tag=tag)
+        with obs.scope(schedule_mod.PHASE_DENSE + tag):
+            loss_k, (dgrads_k, ograds_k) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1))(state.dense_params, outs,
+                                         batch_k)
+        losses.append(loss_k)
+        dense_grads_list.append(dgrads_k)
+        out_grads_list.append(ograds_k)
+        res_list.append(res)
+
+    inv_k = 1.0 / K
+    loss = sum(losses[1:], losses[0]) * inv_k
+    dense_grads = jax.tree.map(
+        lambda *gs: sum(gs[1:], gs[0]) * inv_k, *dense_grads_list)
+    if world > 1:
+        loss = lax.pmean(loss, de.axis_name)
+        dense_grads = jax.tree.map(
+            lambda g: resolve_dp_gradient(g, de.axis_name), dense_grads)
+
+    new_telem = None
+    if telemetry_cfg is not None:
+        # ONE sketch fold + top-k merge over every microbatch's routed
+        # ids — the serialized step's telemetry input, reassembled
+        with obs.scope("telemetry"):
+            new_telem = de.update_telemetry(telem, res_list,
+                                            telemetry_cfg)
+
+    # the serialized step's full-batch cotangents, reassembled exactly:
+    # concatenate per input across microbatches and undo the K× mean
+    # scaling (exact for power-of-two K) — feeds the guard probe, the
+    # health sentinels, and the grad-norm metrics with serialized
+    # semantics
+    cat_grads = [
+        jnp.concatenate([og[i] for og in out_grads_list], axis=0) * inv_k
+        for i in range(len(out_grads_list[0]))]
+
+    ok = None
+    if nan_guard:
+        with obs.scope("nanguard"):
+            # same lockstep-verdict construction as the serialized step
+            # (one pmean — the psum census is K-invariant)
+            probe = jnp.float32(0.0) * _sq_sum(cat_grads)
+            if world > 1:
+                probe = lax.pmean(probe, de.axis_name)
+            ok = (jnp.isfinite(loss.astype(jnp.float32))
+                  & jnp.isfinite(_sq_sum(dense_grads))
+                  & jnp.isfinite(probe))
+
+    lr = lr_schedule(state.step) if callable(lr_schedule) else lr_schedule
+
+    spending = None
+    if streaming_cfg is not None:
+        # ONE admission-staging pass over the concatenated raw streams:
+        # bitwise the serialized step's transition decisions, and an
+        # independent compute chain next to every out/grad exchange
+        spending = de.streaming_stage(serve_list, streaming_cfg, sstate)
+
+    # per-microbatch reverse exchanges + stream rebuilds (each under its
+    # own phase, overlapping other microbatches' dense compute), merged
+    # into ONE optimizer scatter per width slab — grad accumulation
+    # without a second pass over the slabs
+    per_width = {}
+    fallback = next(iter(emb_local.values())).dtype
+    for k in range(K):
+        tag = schedule_mod.microbatch_tag(k)
+        with obs.scope(f"sparse_bwd{tag}"):
+            pw = apply_mod.cotangent_width_streams(
+                de, res_list[k], out_grads_list[k],
+                fallback_dtype=fallback, tag=tag)
+        for key, tris in pw.items():
+            per_width.setdefault(key, []).extend(tris)
+    with obs.scope("sparse_apply"):
+        new_emb, new_emb_opt = apply_mod.apply_width_streams(
+            de, emb_local, emb_opt_local, per_width, emb_optimizer, lr,
+            scale=1.0 / (world * K), enable=ok)
+
+    new_sstate = None
+    sstats = None
+    if streaming_cfg is not None:
+        from . import streaming as streaming_mod
+
+        with obs.scope("streaming_commit"):
+            new_emb, new_emb_opt, new_sstate, sstats = streaming_mod.commit(
+                de, new_emb, spending, sstate, enable=ok,
+                opt_state=new_emb_opt, optimizer=emb_optimizer)
+
+    new_state = _apply_dense_and_assemble(
+        de, state, emb_local, emb_opt_local, new_emb, new_emb_opt,
+        dense_grads, dense_tx, ok, nan_guard)
+    aux_out = ()
+    if new_telem is not None:
+        aux_out += (new_telem,)
+    if new_sstate is not None:
+        aux_out += (new_sstate,)
+    if not with_metrics:
+        return (loss, new_state) + aux_out
+    metrics = None
+    out_dtype = cat_grads[0].dtype if cat_grads else None
+    for res in res_list:
+        m = de.step_metrics(res, out_dtype=out_dtype)
+        if metrics is None:
+            metrics = m
+        else:
+            for mk in m:
+                if mk == "out_pad_frac":
+                    continue  # static plan property, equal per microbatch
+                metrics[mk] = metrics[mk] + m[mk]
+    metrics = _finish_metrics(de, metrics, cat_grads, dense_grads, loss,
+                              ok, state, sstats, lr)
+    return (loss, new_state, metrics) + aux_out
+
+
 def _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer, lr_schedule,
                        state, cat_inputs, batch, with_metrics=False,
                        nan_guard=False, telemetry_cfg=None, telem=None,
@@ -147,7 +465,21 @@ def _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer, lr_schedule,
     state, so the rollback/quarantine machinery sees one coherent
     trajectory. The updated streaming state returns as the step's LAST
     element (after the telemetry state when both ride).
+
+    A ``de.schedule`` with ``microbatches > 1`` (a
+    :func:`~.schedule.pipelined_schedule`) routes to
+    :func:`_pipelined_local_step` — the K-microbatch latency-hiding
+    program with identical call/return signature. K == 1 (the default
+    and every serialized schedule) traces THIS body unchanged, so the
+    serialized program stays bitwise the pre-pipelining step.
     """
+    K = _microbatch_count(de)
+    if K > 1:
+        return _pipelined_local_step(
+            de, loss_fn, dense_tx, emb_optimizer, lr_schedule, state,
+            cat_inputs, batch, K, with_metrics=with_metrics,
+            nan_guard=nan_guard, telemetry_cfg=telemetry_cfg, telem=telem,
+            streaming_cfg=streaming_cfg, sstate=sstate)
     world = de.world_size
     # slabs are {width: [world, rows, w]} globally -> [rows, w] per device
     emb_local = de.local_view(state.emb_params)
@@ -211,33 +543,9 @@ def _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer, lr_schedule,
                 de, new_emb, spending, sstate, enable=ok,
                 opt_state=new_emb_opt, optimizer=emb_optimizer)
 
-    with obs.scope("dense_update"):
-        updates, dense_opt_state = dense_tx.update(
-            dense_grads, state.dense_opt_state, state.dense_params)
-        dense_params = optax.apply_updates(state.dense_params, updates)
-
-    if nan_guard:
-        # slab-shaped leaves are already protected by the sentinel-gated
-        # scatters; only the small leaves need an explicit select — the
-        # dense params/opt state (MBs) and non-slab embedding-optimizer
-        # aux (Adam's step count), never the GB-scale slabs
-        slab_shapes = {tuple(v.shape) for v in emb_local.values()}
-
-        def sel(new, old):
-            return jax.tree.map(lambda n, o: jnp.where(ok, n, o), new, old)
-
-        new_emb_opt = jax.tree.map(
-            lambda n, o: (n if tuple(n.shape) in slab_shapes
-                          else jnp.where(ok, n, o)),
-            new_emb_opt, emb_opt_local)
-        dense_params = sel(dense_params, state.dense_params)
-        dense_opt_state = sel(dense_opt_state, state.dense_opt_state)
-
-    new_state = HybridTrainState(
-        emb_params=de.stacked_view(new_emb),
-        emb_opt_state=de.stacked_view(new_emb_opt),
-        dense_params=dense_params, dense_opt_state=dense_opt_state,
-        step=state.step + 1)
+    new_state = _apply_dense_and_assemble(
+        de, state, emb_local, emb_opt_local, new_emb, new_emb_opt,
+        dense_grads, dense_tx, ok, nan_guard)
     aux_out = ()
     if new_telem is not None:
         aux_out += (new_telem,)
@@ -247,27 +555,8 @@ def _hybrid_local_step(de, loss_fn, dense_tx, emb_optimizer, lr_schedule,
         return (loss, new_state) + aux_out
     metrics = de.step_metrics(
         res, out_dtype=out_grads[0].dtype if out_grads else None)
-    with obs.scope("health_sentinels"):
-        # per-table numerical health, next to the nan-guard: names WHICH
-        # table's cotangents went non-finite/exploded (the recovery log's
-        # "table 3 went unhealthy at step k", not just "step k skipped")
-        metrics.update(_table_sentinels(de, out_grads, lr))
-    # out_grads are device-varying; the pmean'd loss / resolved dense
-    # grads / replicated step are not — _vary marks them for P(axis) out
-    metrics["emb_grad_norm"] = jnp.sqrt(_sq_sum(out_grads)).reshape(1)
-    metrics["dense_grad_norm"] = de._vary(
-        jnp.sqrt(_sq_sum(dense_grads)).reshape(1))
-    metrics["loss"] = de._vary(loss.astype(jnp.float32).reshape(1))
-    skipped = ((1 - ok.astype(jnp.int32)).reshape(1) if ok is not None
-               else jnp.zeros((1,), jnp.int32))
-    metrics["skipped_steps"] = de._vary(skipped)
-    metrics["step"] = de._vary(state.step.astype(jnp.int32).reshape(1))
-    if sstats is not None:
-        # this step's (guard-gated) slot-map transition counts — derived
-        # from the device-varying routed ids, so P(axis) stacks them per
-        # rank like every other metric
-        for k, v in sstats.items():
-            metrics[f"stream_{k}"] = v
+    metrics = _finish_metrics(de, metrics, out_grads, dense_grads, loss,
+                              ok, state, sstats, lr)
     return (loss, new_state, metrics) + aux_out
 
 

@@ -174,6 +174,27 @@ declare("DETPU_EVICT_MARGIN", default="1",
             "slot succeeds only when the incoming estimate >= occupant "
             "frequency + margin (0 = ties evict)")
 
+# pipelined hybrid step (parallel/schedule.py + parallel/trainer.py):
+# K-microbatch software pipelining that hides the all-to-all exchange
+# under dense compute (ROADMAP item 2)
+declare("DETPU_MICROBATCH", default="2",
+        doc="microbatch count K of steps built with a pipelined schedule "
+            "(parallel.schedule.pipelined_schedule(K=None) resolves K "
+            "here — only schedule='pipelined' opt-ins read it; the "
+            "default schedule stays serialized regardless). The global "
+            "batch splits into K chains inside ONE jitted step — "
+            "microbatch k+1's id all-to-all is data-independent of "
+            "microbatch k's dense fwd/bwd, so XLA can overlap them — "
+            "with gradients accumulated so the applied update matches "
+            "the serialized step (K=1 IS the serialized baseline, "
+            "bitwise — the opt-in default is 2 so asking for a pipeline "
+            "actually builds one). The per-device batch must divide by K")
+declare("DETPU_MICROBATCH_BENCH", default="2",
+        doc="microbatch count K of bench.py's `pipeline` section (the "
+            "pipelined-vs-serialized throughput A/B); independent of "
+            "DETPU_MICROBATCH so a bench run never inherits a training "
+            "run's K")
+
 # non-finite guard (utils/obs.py + parallel/trainer.py + resilient.py)
 declare("DETPU_NANGUARD", default="1",
         doc="on-device non-finite guard in the hybrid step; 0 = build the "

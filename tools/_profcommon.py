@@ -69,6 +69,9 @@ def build_case(name: str, world: int, batch: int):
     the profile tools cannot drift apart.
 
     Cases: ``dense`` / ``ragged`` / ``row_sliced`` (the tier-1 shapes),
+    ``pipelined`` — the dense shapes under ``pipelined_schedule(2)``,
+    the K-microbatch case the schedule auditor certifies declared
+    overlaps on and the phase profiler measures —
     ``bigvocab`` — vocab rows >> the id stream, so stateful sparse
     optimizers compile their sort-dedup path instead of the dense-apply
     regime (the configuration the dedup pass budget is pinned on) —
@@ -109,6 +112,21 @@ def build_case(name: str, world: int, batch: int):
                     "combiner": ["sum", None, "mean"][i % 3]}
                    for i in range(10)]
         de = DistributedEmbedding(configs, world_size=world)
+        cats = dense_cats(configs)
+    elif name == "pipelined":
+        # the dense shapes under the K=2 software-pipelined schedule
+        # (parallel/schedule.py): the case the schedule auditor certifies
+        # the DECLARED microbatch overlaps on, the HLO census pins the
+        # per-microbatch pass budgets on, and the measured phase profile
+        # confirms on the clock (ROADMAP item 2)
+        from distributed_embeddings_tpu.parallel.schedule import (
+            pipelined_schedule)
+
+        configs = [{"input_dim": 20 + 6 * i, "output_dim": 4,
+                    "combiner": ["sum", None, "mean"][i % 3]}
+                   for i in range(10)]
+        de = DistributedEmbedding(configs, world_size=world,
+                                  schedule=pipelined_schedule(2))
         cats = dense_cats(configs)
     elif name == "bigvocab":
         # stream << rows: SparseAdagrad's dense_apply_ratio cost model

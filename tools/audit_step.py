@@ -56,8 +56,9 @@ def audit_case(name: str, world: int, batch: int, with_metrics: bool,
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--config", choices=("dense", "ragged", "row_sliced",
-                                         "all"), default="all")
+    ap.add_argument("--config", choices=("dense", "pipelined", "ragged",
+                                         "row_sliced", "all"),
+                    default="all")
     ap.add_argument("--world", type=int, default=8,
                     help="mesh positions (CPU virtual devices; default 8)")
     ap.add_argument("--batch", type=int, default=16, help="global batch")
@@ -75,8 +76,11 @@ def main(argv=None) -> int:
     force_cpu(max(args.world, 1))
     sys.path.insert(0, REPO)
 
-    names = (["dense", "ragged", "row_sliced"] if args.config == "all"
-             else [args.config])
+    # "pipelined" audits the K=2 microbatched step: the a2a census must
+    # show exactly K of each exchange role while the psum count stays
+    # K-invariant (expected_collectives reads de.schedule.microbatches)
+    names = (["dense", "pipelined", "ragged", "row_sliced"]
+             if args.config == "all" else [args.config])
     # (config, telemetry?) cases: --with-telemetry audits only the
     # telemetry-instrumented variants; the default "all" sweep ALSO
     # audits one telemetry case so the verify gate covers the carried
@@ -87,6 +91,12 @@ def main(argv=None) -> int:
     reports = []
     failed = 0
     for name, with_tel in cases:
+        if name == "pipelined" and (args.batch // max(args.world, 1)) % 2:
+            print(f"audit_step: pipelined: skipped — per-device batch "
+                  f"{args.batch // max(args.world, 1)} does not divide "
+                  "into the case's K=2 microbatches (pick --batch "
+                  "divisible by 2*world)")
+            continue
         try:
             rep = audit_case(name, args.world, args.batch,
                              args.with_metrics, with_telemetry=with_tel)

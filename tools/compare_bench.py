@@ -23,7 +23,11 @@ Accepts either the driver's wrapper format (``{"rc": ..., "parsed":
   growing versus the baseline (:func:`check_schedule`) — or a MEASURED
   overlap regression: the trace-parsed ``phase_profile`` section's
   measured serialized fraction growing, or its measured-vs-modeled
-  classification disagreeing (:func:`check_phase_profile`);
+  classification disagreeing (:func:`check_phase_profile`) — both the
+  schedule and phase-profile gates run twice, once for the serialized
+  headline and once for the pipelined twins (``schedule_pipelined`` /
+  ``phase_profile_pipelined``), so the K-microbatch step's won overlap
+  ratchets independently;
 * 2 — unusable inputs (missing file, no parseable payload).
 
 Metrics present in only one record are reported but never fail the gate
@@ -60,6 +64,7 @@ THROUGHPUT_KEYS = (
     "sentinel_samples_per_sec",
     "telemetry_samples_per_sec",
     "streaming_samples_per_sec",
+    "pipeline_samples_per_sec",
 )
 # lower is better (ms-per-iter timings and byte budgets: a >threshold
 # rise in per-step peak HBM is a regression exactly like a slower step)
@@ -325,18 +330,25 @@ SCHEDULE_BYTES_TOL = 0.02
 SCHEDULE_FRACTION_TOL = 0.005
 
 
-def check_schedule(old: Dict[str, Any], new: Dict[str, Any]) -> int:
+def check_schedule(old: Dict[str, Any], new: Dict[str, Any],
+                   key: str = "schedule") -> int:
     """The schedule-graph gate (the overlap ratchet): the bench record
     embeds the schedule auditor's baseline report (``schedule``:
     serialized_collective_fraction, modeled critical-path bytes, and the
     per-collective classification of the headline step's dependency
-    DAG). Three checks:
+    DAG) — and, since the pipelined round, the K=2 pipelined twin
+    (``schedule_pipelined``, checked by a second call with ``key=``).
+    Four checks:
 
     * any contract / declaration violation in the candidate's own
       report fails outright;
     * ``serialized_collective_fraction`` GROWING beyond float tolerance
       fails — overlap, once won, can never silently regress back to a
       serialized exchange;
+    * a collective PHASE the baseline classified overlappable that the
+      candidate classifies serialized fails, even when the fraction
+      math would forgive it (one re-serialized exchange among many
+      cheap ones moves the fraction little but loses the win);
     * modeled ``critical_path_bytes`` growing beyond
       :data:`SCHEDULE_BYTES_TOL` fails — a longer dependency chain is a
       structural regression even before it shows up as milliseconds;
@@ -344,10 +356,10 @@ def check_schedule(old: Dict[str, Any], new: Dict[str, Any]) -> int:
       (the audit crashed or was skipped — silence would hide exactly
       the regressions the gate exists to catch).
     """
-    sec = new.get("schedule")
+    sec = new.get(key)
     if not isinstance(sec, dict):
-        if isinstance(old.get("schedule"), dict):
-            print("compare_bench: candidate record has no schedule "
+        if isinstance(old.get(key), dict):
+            print(f"compare_bench: candidate record has no {key} "
                   "section but the baseline does — the schedule audit "
                   "failed or was skipped; the overlap gate cannot run",
                   file=sys.stderr)
@@ -355,26 +367,55 @@ def check_schedule(old: Dict[str, Any], new: Dict[str, Any]) -> int:
         return 0
     failures = 0
     for v in sec.get("violations") or []:
-        print(f"compare_bench: schedule contract violation in the "
+        print(f"compare_bench: {key} contract violation in the "
               f"candidate record: {v}", file=sys.stderr)
         failures += 1
-    osec = old.get("schedule")
+    osec = old.get(key)
     if not isinstance(osec, dict):
         return failures
     of = osec.get("serialized_collective_fraction")
     nf = sec.get("serialized_collective_fraction")
     if isinstance(of, (int, float)) and isinstance(nf, (int, float)) \
             and nf > of + SCHEDULE_FRACTION_TOL:
-        print(f"compare_bench: schedule REGRESSION: "
+        print(f"compare_bench: {key} REGRESSION: "
               f"serialized_collective_fraction {of:.3f} -> {nf:.3f} — "
               "a collective that used to overlap dense compute is "
               "serialized again", file=sys.stderr)
         failures += 1
+    def classifications(s):
+        """(scope, phase) -> classification over the section's own
+        collectives list AND every per-case list (the headline section
+        keeps its lists under ``cases``; the pipelined twin is flat)."""
+        out = {}
+        for c in s.get("collectives") or []:
+            if isinstance(c, dict):
+                out[("", c.get("phase"))] = c.get("classification")
+        cases = s.get("cases")
+        if isinstance(cases, dict):
+            for label, case in cases.items():
+                if not isinstance(case, dict):
+                    continue
+                for c in case.get("collectives") or []:
+                    if isinstance(c, dict):
+                        out[(label, c.get("phase"))] = c.get(
+                            "classification")
+        return out
+
+    ocls = classifications(osec)
+    for (scope, phase), cls in classifications(sec).items():
+        if ocls.get((scope, phase)) == "overlappable" \
+                and cls == "serialized":
+            where = f" (case {scope!r})" if scope else ""
+            print(f"compare_bench: {key} REGRESSION: collective phase "
+                  f"{phase!r}{where} was overlappable in the baseline "
+                  "but the candidate serializes it — an exchange lost "
+                  "its independent compute", file=sys.stderr)
+            failures += 1
     ob = osec.get("critical_path_bytes")
     nb2 = sec.get("critical_path_bytes")
     if isinstance(ob, (int, float)) and isinstance(nb2, (int, float)) \
             and ob > 0 and nb2 > ob * (1.0 + SCHEDULE_BYTES_TOL):
-        print(f"compare_bench: schedule REGRESSION: modeled "
+        print(f"compare_bench: {key} REGRESSION: modeled "
               f"critical-path bytes {int(ob)} -> {int(nb2)} "
               f"(+{(nb2 / ob - 1) * 100:.1f}%) — the step's dependency "
               "chain got longer", file=sys.stderr)
@@ -389,12 +430,15 @@ def check_schedule(old: Dict[str, Any], new: Dict[str, Any]) -> int:
 PHASE_PROFILE_FRACTION_TOL = 0.10
 
 
-def check_phase_profile(old: Dict[str, Any], new: Dict[str, Any]) -> int:
+def check_phase_profile(old: Dict[str, Any], new: Dict[str, Any],
+                        key: str = "phase_profile") -> int:
     """The measured half of the overlap ratchet: the bench record embeds
     the trace-parsed phase profile of the headline step
     (``phase_profile``: per-phase measured ms, measured a2a fraction,
     measured serialized-collective fraction, capture overhead,
-    measured-vs-modeled agreement). Three checks:
+    measured-vs-modeled agreement) — and, since the pipelined round,
+    the K=2 pipelined twin (``phase_profile_pipelined``, checked by a
+    second call with ``key=``). Three checks:
 
     * any agreement violation in the candidate (a modeled-serialized
       exchange that MEASURED overlapped, or a join failure) fails
@@ -407,10 +451,10 @@ def check_phase_profile(old: Dict[str, Any], new: Dict[str, Any]) -> int:
       (the capture crashed or was skipped — silence would hide exactly
       the regressions the gate exists to catch).
     """
-    sec = new.get("phase_profile")
+    sec = new.get(key)
     if not isinstance(sec, dict):
-        if isinstance(old.get("phase_profile"), dict):
-            print("compare_bench: candidate record has no phase_profile "
+        if isinstance(old.get(key), dict):
+            print(f"compare_bench: candidate record has no {key} "
                   "section but the baseline does — the measured capture "
                   "failed or was skipped; the measured overlap gate "
                   "cannot run", file=sys.stderr)
@@ -418,17 +462,17 @@ def check_phase_profile(old: Dict[str, Any], new: Dict[str, Any]) -> int:
         return 0
     failures = 0
     for v in sec.get("violations") or []:
-        print(f"compare_bench: phase_profile agreement violation in the "
+        print(f"compare_bench: {key} agreement violation in the "
               f"candidate record: {v}", file=sys.stderr)
         failures += 1
-    osec = old.get("phase_profile")
+    osec = old.get(key)
     if not isinstance(osec, dict):
         return failures
     of = osec.get("measured_serialized_fraction")
     nf = sec.get("measured_serialized_fraction")
     if isinstance(of, (int, float)) and isinstance(nf, (int, float)) \
             and nf > of + PHASE_PROFILE_FRACTION_TOL:
-        print(f"compare_bench: phase_profile REGRESSION: measured "
+        print(f"compare_bench: {key} REGRESSION: measured "
               f"serialized fraction {of:.3f} -> {nf:.3f} — an exchange "
               "that used to measure hidden under compute is exposed "
               "again on the clock", file=sys.stderr)
@@ -483,7 +527,10 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
     steady_failures += check_phase_budget(old, new)
     steady_failures += check_plan_audit(old, new)
     steady_failures += check_schedule(old, new)
+    steady_failures += check_schedule(old, new, key="schedule_pipelined")
     steady_failures += check_phase_profile(old, new)
+    steady_failures += check_phase_profile(old, new,
+                                           key="phase_profile_pipelined")
     steady_failures += check_streaming(old, new)
     regressions = 0
     rows = []

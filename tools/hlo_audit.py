@@ -70,6 +70,17 @@ def census_case(name: str, world: int, batch: int, opt_name: str):
     de, cats, batch_tree, dense_params, loss_fn = build_case(
         name, world, batch)
     contracts = list(default_contracts(opt)) + shared_contracts()
+    if name == "pipelined":
+        # the pipelined twin of the exchange budget: every
+        # per-microbatch exchange phase compiles to EXACTLY one
+        # all-to-all — per-microbatch op counts may not grow (a K=2
+        # step is 2x the serialized per-phase budget, never more), and
+        # a microbatch losing its exchange means the pipeline collapsed
+        contracts.append(PassBudget(
+            "*all_to_all_mb*", "all_to_all", max_passes=1, min_passes=1,
+            per_path=True,
+            reason="pipelined step: one exchange per microbatch phase — "
+                   "per-microbatch op counts may not grow"))
     if name == "bigvocab" and opt_name != "sgd":
         # the dedup-regime shapes with a stateful optimizer: the pass must
         # EXIST (its disappearance would mean duplicates silently corrupt
@@ -88,8 +99,8 @@ def census_case(name: str, world: int, batch: int, opt_name: str):
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--config",
-                    choices=("dense", "ragged", "row_sliced", "bigvocab",
-                             "all"),
+                    choices=("dense", "pipelined", "ragged", "row_sliced",
+                             "bigvocab", "all"),
                     default="all")
     ap.add_argument("--world", type=int, default=8,
                     help="mesh positions (CPU virtual devices; default 8)")
@@ -118,8 +129,8 @@ def main(argv=None) -> int:
     # BOTH families — the SGD build must be dedup-free, the Adagrad build
     # must not lose its dedup pass
     if args.config == "all":
-        cases = [("dense", "adagrad"), ("ragged", "adagrad"),
-                 ("row_sliced", "adagrad"),
+        cases = [("dense", "adagrad"), ("pipelined", "adagrad"),
+                 ("ragged", "adagrad"), ("row_sliced", "adagrad"),
                  ("bigvocab", "sgd"), ("bigvocab", "adagrad")]
     elif args.config == "bigvocab":
         cases = [("bigvocab", "sgd"), ("bigvocab", "adagrad")]
@@ -129,6 +140,12 @@ def main(argv=None) -> int:
     reports = []
     failed = 0
     for name, opt_name in cases:
+        if name == "pipelined" and (args.batch // max(args.world, 1)) % 2:
+            print(f"hlo_audit: pipelined: skipped — per-device batch "
+                  f"{args.batch // max(args.world, 1)} does not divide "
+                  "into the case's K=2 microbatches (pick --batch "
+                  "divisible by 2*world)")
+            continue
         try:
             rep = census_case(name, args.world, args.batch, opt_name)
         except Exception as e:  # noqa: BLE001 - report, then fail the gate

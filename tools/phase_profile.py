@@ -62,6 +62,7 @@ except ImportError:  # run as a script: tools/ itself is sys.path[0]
 #: out/grad exchanges the auditor already classifies overlappable
 CASES = (
     ("dense", 8, 256, "adagrad"),
+    ("pipelined", 8, 256, "adagrad"),
     ("streaming", 8, 256, "adagrad"),
 )
 SMOKE_STEPS = 2
@@ -179,7 +180,8 @@ def run_case(name: str, world: int, batch: int, opt_name: str,
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--case", choices=("dense", "streaming", "all"),
+    ap.add_argument("--case",
+                    choices=("dense", "pipelined", "streaming", "all"),
                     default="all")
     ap.add_argument("--steps", type=int, default=None,
                     help="profiled steps per case (default "

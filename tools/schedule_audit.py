@@ -52,6 +52,7 @@ except ImportError:  # run as a script: tools/ itself is sys.path[0]
 #: serialized-baseline classification must not flip on the audit shapes.
 CASES = (
     ("dense", 8, 256, "adagrad"),
+    ("pipelined", 8, 256, "adagrad"),
     ("ragged", 8, 256, "adagrad"),
     ("row_sliced", 8, 256, "adagrad"),
     ("bigvocab", 8, 256, "sgd"),
@@ -74,7 +75,14 @@ def audit_case(name: str, world: int, batch: int, opt_name: str):
         name, world, batch)
     dynamic = StreamingConfig() if name == "streaming" else None
     contracts = None  # baseline_contracts(): all three a2as serialized
-    if name == "streaming":
+    if name == "pipelined":
+        # the K=2 software-pipelined step: every declared microbatch
+        # overlap must EXIST in the compiled DAG (the declaration check
+        # runs via de.schedule) AND every declaring exchange must
+        # classify overlappable — the ROADMAP item 2 acceptance this
+        # gate certifies
+        contracts = sa.declared_overlap_contracts(de.schedule)
+    elif name == "streaming":
         # the auditor's first real finding: the staged slot-map/sketch
         # transitions branch off the received ids and are consumed only
         # at commit — a genuine independent compute chain next to the
@@ -149,8 +157,8 @@ def seeded_drill(world: int, batch: int) -> int:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--config",
-                    choices=("dense", "ragged", "row_sliced", "bigvocab",
-                             "streaming", "criteo1tb", "all"),
+                    choices=("dense", "pipelined", "ragged", "row_sliced",
+                             "bigvocab", "streaming", "criteo1tb", "all"),
                     default="all")
     ap.add_argument("--strict", action="store_true",
                     help="exit 1 on any violation (the make verify gate)")
