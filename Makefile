@@ -6,8 +6,8 @@ SHELL := /bin/bash
 .PHONY: all native test test-fast bench bench-diff clean pkg verify \
         lint plan-audit audit-step hlo-audit schedule-audit check-backend \
         check-obs check-obs-report check-resilience check-reshard \
-        check-recovery check-streaming check-phase-profile obs-report \
-        phase-profile
+        check-recovery check-streaming check-serving check-phase-profile \
+        obs-report phase-profile
 
 all: native
 
@@ -31,7 +31,7 @@ bench:
 # preemption-recovery drill — run before shipping a round
 verify: lint plan-audit audit-step hlo-audit schedule-audit check-backend \
         check-obs check-obs-report check-phase-profile check-resilience \
-        check-reshard check-recovery check-streaming
+        check-reshard check-recovery check-streaming check-serving
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -135,6 +135,15 @@ check-recovery:
 # aux included) CRC-identical to the uninterrupted run
 check-streaming:
 	python tools/check_streaming.py
+
+# serving overload drill: a world-8 child serves a Zipfian request
+# stream under DETPU_FAULT=slow:serve_step+burst@ (every flush slow, a
+# 16x QPS spike at second 2); requires bounded p99, clean typed
+# shedding with degrade/recover events, post-burst recovery, a
+# bitwise-unchanged read-only streaming state, and 0 steady-state
+# recompiles across the padded-batch ladder (parallel/serving.py)
+check-serving:
+	python tools/check_serving.py
 
 # optional regression gate: diff two BENCH records, nonzero exit on a >10%
 # throughput regression. Usage: make bench-diff OLD=BENCH_r04.json NEW=out.json

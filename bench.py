@@ -1122,6 +1122,29 @@ def run_pipeline():
     return rec
 
 
+def run_serving():
+    """Deadline-bounded serving at fixed QPS (ISSUE 15, the inference
+    half of ROADMAP 4): runs ``tools/serve_bench.py`` in a CHILD
+    process pinned to the world-8 virtual-device CPU mesh — requests
+    coalesce into the padded-batch ladder around the donated-input
+    no-grad forward — and embeds p50/p95/p99 latency over served
+    requests, shed/deadline-missed counts, the padding fraction, and
+    the ladder's steady-state recompile count (folded into the
+    record-wide gate: a ladder that retraces per request mix poisons
+    its own latencies). The int8-rows-with-per-row-scales serving-table
+    pricing rides inside (``int8_serving``).
+    ``tools/compare_bench.py::check_serving`` fails a candidate whose
+    p95 grows beyond 10%, whose section recompiles, or whose section
+    disappears versus the baseline."""
+    global _STEADY_RECOMPILES
+    cmd = [os.path.join("tools", "serve_bench.py")]
+    if SMOKE:
+        cmd.append("--smoke")
+    rec, _ = _child_json(cmd, 900, "serve_bench")
+    _STEADY_RECOMPILES += int(rec.get("steady_state_recompiles") or 0)
+    return rec
+
+
 def run_telemetry_overhead():
     """Access-telemetry cost (ISSUE 5): the SAME single-chip DLRM step
     timed with the jit-carried telemetry compiled OUT (the headline
@@ -1667,6 +1690,12 @@ def main():
         # the throughput term is lifted so the regression gate sees it
         out["pipeline"] = pipe
         out["pipeline_samples_per_sec"] = pipe["pipeline_samples_per_sec"]
+    serving = _guard("serving", run_serving)
+    if serving is not None:
+        # fixed-QPS latency percentiles of the serving runtime (p95
+        # ratcheted by compare_bench's check_serving, recompiles folded
+        # into the record-wide steady-state gate)
+        out["serving"] = serving
     telov = _guard("telemetry_overhead", run_telemetry_overhead)
     if telov is not None:
         out["telemetry_overhead"] = telov

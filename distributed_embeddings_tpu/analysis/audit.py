@@ -310,6 +310,33 @@ def expected_collectives(de: DistributedEmbedding, *,
     }
 
 
+def expected_eval_collectives(de: DistributedEmbedding) -> Dict[str, Any]:
+    """The communication contract for one no-grad FORWARD on ``de`` —
+    the serving runtime's compiled program (:mod:`~..parallel.serving`)
+    and :func:`~..parallel.trainer.make_hybrid_eval_step`'s body.
+
+    Half the train contract: the dp-input forward runs the id exchange
+    and the output exchange (1 + 1), mp input only the output exchange,
+    a single worker none — and NOTHING else: no cotangent exchange (no
+    grad), no psum (no loss pmean, no dense-gradient resolution), and
+    the same never-any-all_gather rule as training. A serve program
+    that trips this census is quietly paying training-shaped
+    communication per request.
+    """
+    if de.world_size <= 1:
+        return {"all_to_all_roles": {}, "all_to_all": 0, "psum": 0,
+                "all_gather": 0, "reduce_scatter": 0}
+    roles = (["out_exchange_fwd"] if not de.dp_input
+             else ["id_exchange_fwd", "out_exchange_fwd"])
+    return {
+        "all_to_all_roles": {r: 1 for r in roles},
+        "all_to_all": len(roles),
+        "psum": 0,
+        "all_gather": 0,
+        "reduce_scatter": 0,
+    }
+
+
 def _donation_audit(lowered_text: Optional[str],
                     expected_leaves: int) -> Dict[str, Any]:
     """Count donation markers in the lowered StableHLO. jax marks a donated

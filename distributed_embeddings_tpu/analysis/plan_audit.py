@@ -867,6 +867,83 @@ def compare_with_memory(report: PlanReport,
     return out
 
 
+def price_int8_serving(target,
+                       global_batch: int,
+                       *,
+                       param_dtype="float32",
+                       comm_dtype=None,
+                       scale_bytes: int = 4,
+                       chip: str = "v5e",
+                       encodings: Optional[Sequence[tuple]] = None,
+                       cat_inputs: Optional[Sequence[Any]] = None,
+                       dp_input: Optional[bool] = None,
+                       label: Optional[str] = None) -> Dict[str, Any]:
+    """Price an int8-rows-with-per-row-scales SERVING variant of a plan
+    — pricing only, nothing materializes (the quantized table itself is
+    a future PR; this is its capacity case and the input to the hot-row
+    cache sizing of ROADMAP item 1).
+
+    The variant: frozen inference tables store each logical row as
+    ``width`` int8 codes plus one ``scale_bytes``-wide per-row scale,
+    dequantized after the gather. Two effects priced here:
+
+    * **per-rank HBM** — the serving table bill drops from
+      ``rows x width x itemsize`` to ``rows x (width + scale_bytes)``
+      (~4x for fp32 tables, ~2x for bf16, minus the per-row scale tax
+      that bites narrow widths hardest); no optimizer state exists at
+      serve time, so tables ARE the resident bill.
+    * **out-a2a payload** — when the exchange ships the quantized rows
+      (one scale per routed slot) and dequantizes on the receiving
+      side, the activation payload shrinks by the same code/scale
+      arithmetic — fewer off-chip bytes per request on exactly the
+      exchange the serving runtime's latency rides.
+
+    Returns a plain JSON-able dict next to a baseline
+    :func:`audit_plan` run (optimizer ``"sgd"`` — zero slots, the
+    inference bill). Keyed so ``tools/serve_bench.py`` can embed it in
+    the bench ``serving`` section.
+    """
+    strategy = (target if hasattr(target, "local_configs_list")
+                else target.strategy)
+    base = audit_plan(target, global_batch, optimizer="sgd",
+                      param_dtype=param_dtype, comm_dtype=comm_dtype,
+                      encodings=encodings, cat_inputs=cat_inputs,
+                      dp_input=dp_input, chip=chip, label=label)
+    p_isz = _dtype_bytes(param_dtype)
+    c_isz = _dtype_bytes(base.comm_dtype)
+    geom = slab_geometry(strategy)
+    base_table = geom.rank_param_bytes(p_isz)
+    int8_table = sum(geom.rows_cap[w] * (w + scale_bytes)
+                     for w in geom.widths)
+    # one scale per routed (sample, slot) pair rides the quantized
+    # exchange next to the int8 codes; s_max counts padded columns, the
+    # group slot counts the scales
+    n_slots = sum(g["slots"] for g in base.groups)
+    off = max(base.world - 1, 0)
+    int8_out = off * base.local_batch * (base.s_max + n_slots * scale_bytes)
+    spec = CHIP_SPECS[chip]
+    return {
+        "label": base.label,
+        "world": base.world,
+        "param_dtype": base.param_dtype,
+        "scale_bytes": int(scale_bytes),
+        "table_bytes_per_rank": int(base_table),
+        "int8_table_bytes_per_rank": int(int8_table),
+        "table_bytes_ratio": (base_table / int8_table
+                              if int8_table else 0.0),
+        "hbm_frac": base_table / spec.hbm_bytes,
+        "int8_hbm_frac": int8_table / spec.hbm_bytes,
+        "out_a2a_bytes_per_step": int(base.out_a2a_bytes_per_step),
+        "int8_out_a2a_bytes_per_step": int(int8_out),
+        "out_a2a_ratio": (base.out_a2a_bytes_per_step / int8_out
+                          if int8_out else 0.0),
+        "comm_dtype_bytes": int(c_isz),
+        "note": "pricing only — the quantized serving table is a "
+                "future PR; dequantize-after-gather assumed, "
+                "one scale per logical row / per routed slot",
+    }
+
+
 def rank_strategies(configs,
                     world: int,
                     global_batch: int,

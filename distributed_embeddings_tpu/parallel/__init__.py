@@ -44,6 +44,15 @@ from .schedule import (
     resolve_schedule,
     streaming_schedule,
 )
+from .serving import (
+    Expired,
+    Failed,
+    Overloaded,
+    Request,
+    ServeConfig,
+    Served,
+    ServingRuntime,
+)
 from .streaming import (
     StreamingConfig,
     init_streaming,

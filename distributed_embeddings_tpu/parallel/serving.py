@@ -1,0 +1,882 @@
+"""Deadline-bounded serving runtime: request coalescing, overload
+admission control, and graceful degradation at QPS.
+
+Every robustness subsystem before this one protects *training*
+(preemption, rollback, elastic resume, streaming degradation); this
+module is the inference half of "millions of users": answering
+variable-size lookup requests under a latency SLO without recompiling
+and without falling over when traffic spikes (ROADMAP item 4's serving
+scenario). Three pieces, all host-side around ONE compiled program
+family:
+
+* **The compiled forward** — a no-grad step built from
+  :func:`~.trainer.make_hybrid_eval_step` with ``donate_inputs=True``
+  (each flush's freshly packed input buffers are dead the moment the
+  step consumes them) and frozen tables. Streaming tables serve
+  READ-ONLY: admitted ids read their slots, cold/evicted ids degrade to
+  their shared hash-bucket rows, and no admission/eviction runs at
+  serve time — the slot map, sketch and counters are bitwise-unchanged
+  by any amount of serving. The program family is a small fixed
+  **ladder** of padded batch shapes (one compiled executable per rung,
+  warmed up front), so steady-state serving is pinned to ZERO
+  recompiles by the same compile-listener counter the bench gates on.
+* **The request coalescer** — variable-size requests (1..n samples
+  each, single-hot, fixed multi-hot, or ragged-hotness inputs) are
+  packed FIFO into the smallest rung that holds them; padding samples
+  are whole fake rows (id 0, zero features) whose predictions are
+  sliced off, and the padding fraction is a first-class metric (every
+  padded slot is latency and exchange bytes spent on nobody).
+* **The robustness core** — a deadline scheduler (flush on ``max_batch``
+  OR ``max_wait_ms``, with per-request deadline propagation: the flush
+  happens early when the oldest deadline demands it, and requests
+  already past their deadline are dropped with a typed
+  :class:`Expired` instead of wasting a rung) and an overload admission
+  controller with an explicit DEGRADATION LADDER:
+
+  - **level 0 (healthy)** — batch up to ``max_wait_ms`` for efficiency;
+  - **level 1 (pressure)** — a full rung is queued: the batching delay
+    shrinks to zero and the queue drains flush-after-flush;
+  - **level 2 (shed)** — the queue passed ``shed_frac x max_queue``:
+    new lowest-priority (``priority <= 0``) requests are refused with a
+    typed :class:`Overloaded` response while higher-priority traffic
+    keeps being served; at ``max_queue`` everything incoming is shed.
+    Queue growth is bounded by construction — there is no input rate at
+    which memory grows without bound.
+
+  Every level transition is surfaced via
+  :func:`~..utils.obs.record_event` (``serve_degraded`` /
+  ``serve_recovered``) and the served/shed/deadline-missed counts bump
+  the process counters next to the recompile counter.
+
+Drills: ``DETPU_FAULT=slow:serve_step`` injects latency into every
+flush (the degraded-backend drill) and ``DETPU_FAULT=burst@<pos>``
+makes :func:`drive` spike the arrival rate during second ``<pos>`` of
+the stream (the QPS-spike drill). ``tools/check_serving.py`` (= ``make
+check-serving``) runs both against the ladder in CI and requires
+bounded p99, clean typed shedding, zero steady-state recompiles, and
+post-burst recovery; ``tools/serve_bench.py`` measures p50/p95/p99 at a
+fixed Zipfian QPS for the bench ``serving`` section.
+
+The runtime is single-threaded and clock-injectable: callers own the
+loop (``submit`` + ``poll``), tests drive a manual clock, and
+:func:`drive` is the shared real-time load loop the tools use. Nothing
+here imports a backend beyond what the compiled forward already needs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import (Any, Callable, Dict, List, Optional, Sequence, Tuple)
+
+import jax
+import numpy as np
+
+from ..utils import envvars, obs
+from ..utils import runtime as runtime_mod
+from ..ops.embedding_lookup import Ragged
+from . import streaming as streaming_mod
+from .trainer import make_hybrid_eval_step
+
+logger = logging.getLogger(__name__)
+
+#: degradation-ladder levels (index = level)
+LEVELS = ("healthy", "pressure", "shed")
+
+#: rolling-window size of the latency / queue-depth samples behind
+#: ``stats()``'s percentiles — a long-running server must not grow host
+#: state per request (the same bounded-by-construction rule the queue
+#: obeys); percentiles describe the most recent window
+STATS_WINDOW = 16384
+
+
+class ServeConfig:
+    """Static serving policy (ladder, deadlines, admission bounds).
+
+    A plain attribute bag (hashable not required — the runtime closes
+    over it host-side only). ``rungs`` overrides the power-of-two
+    ladder; every rung must be divisible by the world size (the
+    shard_map splits the padded batch evenly over ranks).
+    """
+
+    def __init__(self,
+                 max_batch: Optional[int] = None,
+                 rungs: Optional[Sequence[int]] = None,
+                 max_wait_ms: Optional[float] = None,
+                 deadline_ms: Optional[float] = None,
+                 max_queue: Optional[int] = None,
+                 shed_frac: Optional[float] = None,
+                 ragged_hotness: int = 0):
+        env_rungs = envvars.get("DETPU_SERVE_RUNGS") or ""
+        if rungs is None and env_rungs.strip():
+            rungs = [int(x) for x in env_rungs.split(",") if x.strip()]
+        self.rungs = tuple(int(r) for r in rungs) if rungs else None
+        self.max_batch = int(
+            max_batch if max_batch is not None
+            else (self.rungs[-1] if self.rungs
+                  else envvars.get_int("DETPU_SERVE_MAX_BATCH")))
+        self.max_wait_ms = float(
+            max_wait_ms if max_wait_ms is not None
+            else envvars.get_float("DETPU_SERVE_MAX_WAIT_MS"))
+        self.deadline_ms = float(
+            deadline_ms if deadline_ms is not None
+            else envvars.get_float("DETPU_SERVE_DEADLINE_MS"))
+        self.max_queue = int(
+            max_queue if max_queue is not None
+            else envvars.get_int("DETPU_SERVE_MAX_QUEUE"))
+        self.shed_frac = float(
+            shed_frac if shed_frac is not None
+            else envvars.get_float("DETPU_SERVE_SHED_FRAC"))
+        #: per-sample id budget of ragged (list-of-lists) inputs; the
+        #: rung's static value capacity is ``rung x ragged_hotness``.
+        #: 0 = no ragged inputs accepted
+        self.ragged_hotness = int(ragged_hotness)
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if not (0.0 < self.shed_frac <= 1.0):
+            raise ValueError("shed_frac must be in (0, 1]")
+        if self.max_queue < self.max_batch:
+            raise ValueError(
+                f"max_queue ({self.max_queue}) must hold at least one "
+                f"full batch ({self.max_batch}) — a queue smaller than "
+                "a rung sheds healthy traffic")
+
+
+def resolve_rungs(config: ServeConfig, world: int) -> Tuple[int, ...]:
+    """The padded-batch ladder: explicit ``config.rungs`` validated, or
+    powers of two from ``max(8, world)`` up to ``max_batch`` (each
+    rounded up to a ``world`` multiple). One compiled executable per
+    rung — keep the ladder small; every rung is a warmup compile."""
+    if config.rungs:
+        rungs = list(config.rungs)
+        if sorted(rungs) != rungs or len(set(rungs)) != len(rungs):
+            raise ValueError(f"rungs must be strictly ascending: {rungs}")
+        for r in rungs:
+            if r < 1 or r % world:
+                raise ValueError(
+                    f"rung {r} is not a positive multiple of world "
+                    f"{world}")
+        return tuple(rungs)
+
+    def up(x: int) -> int:
+        return ((x + world - 1) // world) * world
+
+    lo = up(max(8, world))
+    # the TOP rung rounds DOWN to a world multiple (never past the
+    # configured max_batch — admission and the max_queue validation
+    # bind against it), except when max_batch < world, where one
+    # world-sized rung is the minimum viable ladder
+    hi = max(world, (config.max_batch // world) * world)
+    rungs = []
+    r = lo
+    while r < hi:
+        rungs.append(r)
+        r *= 2
+    rungs.append(hi)
+    return tuple(sorted(set(rungs)))
+
+
+# ---------------------------------------------------------------- requests
+
+
+@dataclasses.dataclass
+class Request:
+    """One inference request: ``n`` samples of categorical ids (+ the
+    dense ``batch`` pytree the ``pred_fn`` consumes).
+
+    ``cats`` holds one entry per model input: an int array ``[n]``
+    (single-hot), ``[n, h]`` (fixed multi-hot), or a length-``n`` list
+    of id lists (ragged hotness — per-sample lists longer than the
+    configured ``ragged_hotness`` budget are clipped and counted).
+    Higher ``priority`` survives longer under overload; ``deadline_ms``
+    (from submit time) defaults to the config's."""
+
+    cats: Sequence[Any]
+    batch: Any = None
+    priority: int = 0
+    deadline_ms: Optional[float] = None
+    # filled in by submit():
+    rid: int = -1
+    n: int = 0
+    t_submit: float = 0.0
+    deadline: float = 0.0
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """Base of the typed responses (``isinstance`` IS the status)."""
+
+    rid: int
+    latency_ms: float
+
+    @property
+    def status(self) -> str:
+        return type(self).__name__.lower()
+
+
+@dataclasses.dataclass
+class Served(ServeResult):
+    """Predictions for one request, sliced from its flush."""
+
+    predictions: Any = None
+    rung: int = 0
+    deadline_missed: bool = False  # completed, but after the deadline
+
+
+@dataclasses.dataclass
+class Overloaded(ServeResult):
+    """Typed load-shed rejection: the admission controller refused the
+    request (full queue, or shed level + low priority). The caller can
+    retry after backing off — nothing about the request was wrong."""
+
+    reason: str = "queue_full"
+    level: int = 0
+    queue_samples: int = 0
+
+
+@dataclasses.dataclass
+class Expired(ServeResult):
+    """The request's deadline passed while it was still queued — the
+    scheduler dropped it instead of spending a rung on an answer nobody
+    is waiting for. Counted ``deadline_missed``."""
+
+    deadline_ms: float = 0.0
+
+
+@dataclasses.dataclass
+class Failed(ServeResult):
+    """The flush this request was coalesced into raised (injected
+    fault, transient backend error, a pred_fn bug): the request is
+    consumed and answered TYPED instead of the exception escaping
+    ``poll()`` and silently losing every co-batched request — one bad
+    flush must never kill the serving loop. Counted ``failed``;
+    recorded as a ``serve_flush_error`` event."""
+
+    reason: str = ""
+
+
+# ----------------------------------------------------------- the runtime
+
+
+class ServingRuntime:
+    """Single-threaded deadline-bounded server around one compiled
+    forward family.
+
+    Usage::
+
+        rt = ServingRuntime(de, pred_fn, state, mesh=mesh,
+                            config=ServeConfig(max_batch=128))
+        rt.warmup((template_cats, template_batch))
+        rej = rt.submit(Request(cats=..., batch=...))  # None or Overloaded
+        results += rt.poll()                           # flushes when due
+
+    ``streaming=(StreamingConfig, streaming_state)`` serves dynamic
+    tables read-only (cold ids degrade to their buckets; the state is
+    never donated, never mutated). ``clock`` is injectable for
+    deterministic tests; ``poll(now=...)`` accepts explicit time.
+    """
+
+    def __init__(self, de, pred_fn: Callable, state, mesh=None,
+                 config: Optional[ServeConfig] = None,
+                 streaming: Optional[tuple] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.de = de
+        self.config = config or ServeConfig()
+        self.world = int(de.world_size)
+        if self.world > 1 and mesh is None:
+            raise ValueError("mesh is required for world_size > 1")
+        if not de.dp_input:
+            raise ValueError(
+                "ServingRuntime requires dp_input=True: requests arrive "
+                "as data-parallel id shards and ride the id exchange "
+                "(pre-packed MpInputs cannot be coalesced per request)")
+        self.rungs = resolve_rungs(self.config, self.world)
+        self.state = state
+        self._clock = clock
+        self._streaming_cfg = None
+        self.streaming_state = None
+        if streaming is not None:
+            cfg, sstate = streaming
+            self._streaming_cfg = streaming_mod.resolve_config(cfg)
+            self.streaming_state = sstate
+        self._eval = make_hybrid_eval_step(
+            de, pred_fn, mesh=mesh, dynamic=self._streaming_cfg,
+            donate_inputs=True)
+        self._queue: List[Request] = []
+        self._queued_samples = 0
+        self._level = 0
+        self._next_rid = 0
+        self._input_spec: Optional[List[tuple]] = None
+        self._batch_spec: Optional[Any] = None
+        self._est_s = 0.0           # EMA of flush wall seconds
+        self._warm = False
+        self.warmup_compiles = 0
+        self._compiles_at_steady = 0
+        self._lat_ms: List[float] = []
+        self._qdepth: List[int] = []
+        self._pad_slots = 0
+        self._total_slots = 0
+        self._rung_flushes: Dict[int, int] = {r: 0 for r in self.rungs}
+        self._counts = {"served": 0, "shed": 0, "deadline_missed": 0,
+                        "expired": 0, "failed": 0, "flushes": 0,
+                        "served_samples": 0, "ragged_clipped": 0,
+                        "degraded": 0, "recovered": 0}
+
+    # ------------------------------------------------------------ intake
+
+    def _normalize(self, req: Request, now: float) -> Request:
+        """Derive ``n``, validate shapes against the (template-derived)
+        input spec, clip over-budget ragged rows, stamp the deadline."""
+        if len(req.cats) != len(self.de.strategy.input_table_map):
+            raise ValueError(
+                f"request has {len(req.cats)} categorical inputs, the "
+                f"model takes {len(self.de.strategy.input_table_map)}")
+        spec = self._spec_of(req.cats, req.batch)
+        if self._input_spec is None:
+            self._input_spec, self._batch_spec = spec
+        elif spec[0] != self._input_spec:
+            raise ValueError(
+                f"request input spec {spec[0]} does not match the "
+                f"warmed-up spec {self._input_spec} — one compiled "
+                "ladder serves one input layout")
+        elif spec[1] != self._batch_spec:
+            # reject HERE, while nothing is queued: a malformed batch
+            # that only failed at pack time would crash the flush and
+            # lose every healthy request coalesced with it
+            raise ValueError(
+                f"request batch spec {spec[1]} does not match the "
+                f"warmed-up spec {self._batch_spec}")
+        n = None
+        for i, c in enumerate(req.cats):
+            ni = len(c) if isinstance(c, (list, tuple)) \
+                else int(np.asarray(c).shape[0])
+            if n is None:
+                n = ni
+            elif n != ni:
+                raise ValueError(
+                    f"input {i} has {ni} samples, input 0 has {n}")
+        if not n:
+            raise ValueError("empty request")
+        if n > self.rungs[-1]:
+            raise ValueError(
+                f"request of {n} samples exceeds the largest rung "
+                f"{self.rungs[-1]} — split it client-side")
+        hot = self.config.ragged_hotness
+        cats = []
+        for i, c in enumerate(req.cats):
+            if isinstance(c, (list, tuple)):
+                rows = []
+                for row in c:
+                    row = list(row)
+                    if len(row) > hot:
+                        self._counts["ragged_clipped"] += len(row) - hot
+                        row = row[:hot]
+                    rows.append(row)
+                cats.append(rows)
+            else:
+                cats.append(np.asarray(c))
+        req.cats = cats
+        req.n = int(n)
+        req.rid = self._next_rid
+        self._next_rid += 1
+        req.t_submit = now
+        dl = (req.deadline_ms if req.deadline_ms is not None
+              else self.config.deadline_ms)
+        req.deadline_ms = float(dl)
+        req.deadline = now + dl / 1e3
+        return req
+
+    def _spec_of(self, cats, batch) -> tuple:
+        spec = []
+        for c in cats:
+            if isinstance(c, (list, tuple)):
+                if self.config.ragged_hotness < 1:
+                    raise ValueError(
+                        "ragged (list-of-lists) input needs "
+                        "ServeConfig(ragged_hotness=...) > 0")
+                spec.append(("r", self.config.ragged_hotness))
+            else:
+                a = np.asarray(c)
+                if a.ndim == 1:
+                    spec.append(("d", 1))
+                elif a.ndim == 2:
+                    spec.append(("d", int(a.shape[1])))
+                else:
+                    raise ValueError(
+                        f"categorical input rank {a.ndim} unsupported")
+        bspec = jax.tree.map(
+            lambda a: (tuple(np.asarray(a).shape[1:]),
+                       np.asarray(a).dtype.str), batch)
+        return spec, bspec
+
+    def submit(self, req: Request,
+               now: Optional[float] = None) -> Optional[Overloaded]:
+        """Admit one request. Returns ``None`` (queued — the answer
+        arrives from a later :meth:`poll`) or a typed
+        :class:`Overloaded` when the admission controller sheds it."""
+        now = self._clock() if now is None else now
+        req = self._normalize(req, now)
+        q = self._queued_samples
+        shed_at = self.config.shed_frac * self.config.max_queue
+        reason = None
+        if q + req.n > self.config.max_queue:
+            reason = "queue_full"
+        elif q >= shed_at and req.priority <= 0:
+            reason = "load_shed"
+        if reason is not None:
+            self._counts["shed"] += 1
+            obs.counter_inc("serve_shed")
+            self._update_level()
+            return Overloaded(rid=req.rid, latency_ms=0.0, reason=reason,
+                              level=self._level, queue_samples=q)
+        self._queue.append(req)
+        self._queued_samples += req.n
+        self._qdepth.append(self._queued_samples)
+        if len(self._qdepth) > 2 * STATS_WINDOW:
+            del self._qdepth[:-STATS_WINDOW]
+        self._update_level()
+        return None
+
+    @property
+    def queued_samples(self) -> int:
+        return self._queued_samples
+
+    @property
+    def level(self) -> int:
+        """Current degradation-ladder level (0 healthy, 1 pressure,
+        2 shed)."""
+        return self._level
+
+    # ------------------------------------------------- degradation ladder
+
+    def _target_level(self, q: int) -> int:
+        if q >= self.config.shed_frac * self.config.max_queue:
+            return 2
+        if q >= self.rungs[-1]:
+            return 1
+        return 0
+
+    def _set_level(self, new: int, q: int) -> None:
+        old = self._level
+        if new == old:
+            return
+        self._level = new
+        if new > old:
+            self._counts["degraded"] += 1
+            obs.record_event("serve_degraded", level=new, from_level=old,
+                             level_name=LEVELS[new], queue_samples=q)
+            logger.warning("serving degraded to %s (queue %d samples)",
+                           LEVELS[new], q)
+        else:
+            self._counts["recovered"] += 1
+            obs.record_event("serve_recovered", level=new, from_level=old,
+                             level_name=LEVELS[new], queue_samples=q)
+            logger.info("serving recovered to %s (queue %d samples)",
+                        LEVELS[new], q)
+
+    def _update_level(self) -> None:
+        self._set_level(self._target_level(self._queued_samples),
+                        self._queued_samples)
+
+    # ----------------------------------------------------------- packing
+
+    def _rung_for(self, n: int) -> int:
+        for r in self.rungs:
+            if r >= n:
+                return r
+        return self.rungs[-1]
+
+    def _zero_inputs(self, rung: int):
+        """Zero-filled padded inputs of one rung (warmup / audit)."""
+        if self._input_spec is None:
+            raise RuntimeError("call warmup(template) first — the input "
+                               "layout comes from the template request")
+        return self._pack([], rung)
+
+    def _pack(self, reqs: List[Request], rung: int):
+        """Coalesce ``reqs`` (total samples <= rung) into one padded
+        rung-shaped input set. Padding samples are whole fake rows: id 0
+        everywhere, zero dense features, zero-length ragged rows —
+        their predictions are sliced off below."""
+        import jax.numpy as jnp
+
+        spec, bspec = self._input_spec, self._batch_spec
+        offsets = []
+        off = 0
+        for r in reqs:
+            offsets.append(off)
+            off += r.n
+        cats_out = []
+        for i, (kind, hot) in enumerate(spec):
+            if kind == "d":
+                shape = (rung,) if hot == 1 else (rung, hot)
+                buf = np.zeros(shape, np.int32)
+                for r, o in zip(reqs, offsets):
+                    a = np.asarray(r.cats[i], np.int32)
+                    buf[o:o + r.n] = a if hot > 1 or a.ndim == 1 \
+                        else a.reshape(r.n)
+                cats_out.append(jnp.asarray(buf))
+            else:
+                # ragged: per-SHARD CSR segments concatenated, so the
+                # shard_map P(axis) split hands each rank a local
+                # (values[cap_local], row_splits[b_local+1]) pair
+                b_local = rung // self.world
+                cap_local = b_local * hot
+                values = np.zeros((self.world * cap_local,), np.int32)
+                splits = np.zeros((self.world * (b_local + 1),), np.int32)
+                row_lists: List[List[int]] = [[] for _ in range(rung)]
+                for r, o in zip(reqs, offsets):
+                    for j, row in enumerate(r.cats[i]):
+                        row_lists[o + j] = row
+                for s in range(self.world):
+                    base = s * cap_local
+                    pos = 0
+                    sbase = s * (b_local + 1)
+                    splits[sbase] = 0
+                    for j in range(b_local):
+                        row = row_lists[s * b_local + j]
+                        values[base + pos:base + pos + len(row)] = row
+                        pos += len(row)
+                        splits[sbase + j + 1] = pos
+                cats_out.append(Ragged(values=jnp.asarray(values),
+                                       row_splits=jnp.asarray(splits)))
+
+        def pack_leaf(path_spec, leaves):
+            trailing, dtype = path_spec
+            buf = np.zeros((rung,) + trailing, np.dtype(dtype))
+            for r, o, leaf in zip(reqs, offsets, leaves):
+                buf[o:o + r.n] = np.asarray(leaf)
+            return jnp.asarray(buf)
+
+        if bspec is None or not jax.tree.leaves(bspec):
+            batch_out = bspec if bspec is None else jax.tree.map(
+                lambda s: None, bspec)
+        else:
+            req_leaves = [jax.tree.leaves(r.batch) for r in reqs] or None
+            flat_spec, tree = jax.tree_util.tree_flatten(
+                self._batch_spec, is_leaf=lambda x: isinstance(x, tuple)
+                and len(x) == 2 and isinstance(x[1], str))
+            packed = []
+            for li, s in enumerate(flat_spec):
+                leaves = ([rl[li] for rl in req_leaves]
+                          if req_leaves else [])
+                packed.append(pack_leaf(s, leaves))
+            batch_out = jax.tree_util.tree_unflatten(tree, packed)
+        return cats_out, batch_out, offsets
+
+    # ----------------------------------------------------------- serving
+
+    def warmup(self, template) -> int:
+        """Compile the whole ladder up front from a ``(cats, batch)``
+        template (one representative request's inputs). Installs the
+        compile listener and records the warmup compile count; after
+        this, :meth:`steady_recompiles` must stay 0 whatever mix of
+        request sizes arrives — the property ``make check-serving``
+        drills. Returns the number of warmup compiles."""
+        import warnings
+
+        obs.install_compile_listener()
+        cats, batch = template
+        self._input_spec, self._batch_spec = self._spec_of(cats, batch)
+        before = obs.counters().get("recompiles", 0)
+        for rung in self.rungs:
+            c, b, _ = self._pack([], rung)
+            with warnings.catch_warnings():
+                # input donation is best-effort: a backend that cannot
+                # alias an int32 id buffer into the f32 predictions
+                # warns per compile — expected here, not actionable
+                warnings.filterwarnings(
+                    "ignore", message="Some donated buffers were not")
+                out = self._dispatch(c, b)
+            np.asarray(out)  # block: the compile must finish inside warmup
+        self.warmup_compiles = obs.counters().get("recompiles", 0) - before
+        self._compiles_at_steady = obs.counters().get("recompiles", 0)
+        self._warm = True
+        return self.warmup_compiles
+
+    def steady_recompiles(self) -> int:
+        """Compiles observed since :meth:`warmup` finished — the serving
+        analogue of the bench's ``steady_state_recompiles`` gate."""
+        if not self._warm:
+            return 0
+        return obs.counters().get("recompiles", 0) - self._compiles_at_steady
+
+    def _dispatch(self, cats, batch):
+        if self.streaming_state is not None:
+            return self._eval(self.state, cats, batch,
+                              self.streaming_state)
+        return self._eval(self.state, cats, batch)
+
+    def _run_flush(self, reqs: List[Request],
+                   rung: int) -> List[Served]:
+        runtime_mod.fault_point("serve_step")
+        t0 = self._clock()
+        cats, batch, offsets = self._pack(reqs, rung)
+        preds = np.asarray(self._dispatch(cats, batch))
+        t1 = self._clock()
+        self._est_s = (t1 - t0 if not self._est_s
+                       else 0.7 * self._est_s + 0.3 * (t1 - t0))
+        n = sum(r.n for r in reqs)
+        self._pad_slots += rung - n
+        self._total_slots += rung
+        self._counts["flushes"] += 1
+        self._rung_flushes[rung] = self._rung_flushes.get(rung, 0) + 1
+        if len(self._lat_ms) > 2 * STATS_WINDOW:
+            del self._lat_ms[:-STATS_WINDOW]
+        out = []
+        for r, o in zip(reqs, offsets):
+            lat = (t1 - r.t_submit) * 1e3
+            missed = t1 > r.deadline
+            self._lat_ms.append(lat)
+            self._counts["served"] += 1
+            self._counts["served_samples"] += r.n
+            if missed:
+                self._counts["deadline_missed"] += 1
+                obs.counter_inc("serve_deadline_missed")
+            obs.counter_inc("serve_served")
+            out.append(Served(rid=r.rid, latency_ms=lat,
+                              predictions=preds[o:o + r.n], rung=rung,
+                              deadline_missed=missed))
+        return out
+
+    def poll(self, now: Optional[float] = None) -> List[ServeResult]:
+        """Run the scheduler once: expire dead requests, flush every due
+        batch, update the degradation level. Returns the completed
+        results (:class:`Served` / :class:`Expired`); call it often —
+        it is cheap when nothing is due."""
+        out: List[ServeResult] = []
+        explicit = now is not None
+        while True:
+            t = now if explicit else self._clock()
+            # deadline propagation, part 1: requests already past their
+            # deadline are dead weight — drop them (typed) rather than
+            # spend rung slots on them (strictly past: at exactly the
+            # deadline the flush below still gets its chance)
+            keep = []
+            for r in self._queue:
+                if r.deadline < t:
+                    self._queued_samples -= r.n
+                    self._counts["expired"] += 1
+                    self._counts["deadline_missed"] += 1
+                    obs.counter_inc("serve_deadline_missed")
+                    out.append(Expired(rid=r.rid,
+                                       latency_ms=(t - r.t_submit) * 1e3,
+                                       deadline_ms=r.deadline_ms))
+                else:
+                    keep.append(r)
+            self._queue = keep
+            if not self._queue:
+                break
+            oldest = self._queue[0]
+            full = self._queued_samples >= self.rungs[-1]
+            # degradation ladder, level 1: under pressure the batching
+            # delay shrinks to zero — latency is spent on compute only
+            wait_s = (0.0 if self._level >= 1
+                      else self.config.max_wait_ms / 1e3)
+            timed_out = t >= oldest.t_submit + wait_s
+            # deadline propagation, part 2: flush early when the
+            # TIGHTEST queued deadline (not necessarily the oldest
+            # request's) would be missed by waiting any longer (the
+            # flush itself costs ~est_s)
+            tightest = min(r.deadline for r in self._queue)
+            deadline_due = t + self._est_s >= tightest
+            if not (full or timed_out or deadline_due):
+                break
+            out.extend(self._flush_picked())
+        self._update_level()
+        return out
+
+    def _flush_picked(self) -> List[ServeResult]:
+        """Pop one rung's worth of requests FIFO and run the flush.
+        Shared by :meth:`poll` and :meth:`flush` (ONE packing policy);
+        a flush that raises answers its requests with typed
+        :class:`Failed` instead of letting the exception escape and
+        lose every co-batched request."""
+        picked: List[Request] = []
+        total = 0
+        while self._queue and total + self._queue[0].n <= self.rungs[-1]:
+            r = self._queue.pop(0)
+            picked.append(r)
+            total += r.n
+        self._queued_samples -= total
+        try:
+            return self._run_flush(picked, self._rung_for(total))
+        except Exception as e:  # noqa: BLE001 - typed failure, see Failed
+            self._counts["failed"] += len(picked)
+            obs.counter_inc("serve_failed", len(picked))
+            obs.record_event("serve_flush_error", error=repr(e),
+                             requests=len(picked))
+            logger.exception("serve flush failed (%d request(s) answered "
+                             "Failed)", len(picked))
+            t = self._clock()
+            return [Failed(rid=r.rid,
+                           latency_ms=(t - r.t_submit) * 1e3,
+                           reason=repr(e)) for r in picked]
+
+    def flush(self, now: Optional[float] = None) -> List[ServeResult]:
+        """Force every queued request out (drain), regardless of the
+        batching delay — shutdown / test helper."""
+        del now  # kept for signature symmetry with poll()
+        out: List[ServeResult] = []
+        while self._queue:
+            out.extend(self._flush_picked())
+        self._update_level()
+        return out
+
+    # ------------------------------------------------------------- stats
+
+    def stats(self) -> Dict[str, Any]:
+        """Host summary: counts, latency percentiles over served
+        requests, aggregate pad fraction, queue-depth p95, recompile
+        verdicts — the dict the bench section and the check drill
+        read."""
+        lat = np.asarray(self._lat_ms, np.float64)
+        q = np.asarray(self._qdepth, np.float64)
+        pct = (lambda p: float(np.percentile(lat, p))) if lat.size \
+            else (lambda p: None)
+        return {
+            **self._counts,
+            "level": self._level,
+            "level_name": LEVELS[self._level],
+            "queued_samples": self._queued_samples,
+            "latency_p50_ms": pct(50),
+            "latency_p95_ms": pct(95),
+            "latency_p99_ms": pct(99),
+            "pad_fraction": (self._pad_slots / self._total_slots
+                             if self._total_slots else 0.0),
+            "queue_depth_p95": (float(np.percentile(q, 95))
+                                if q.size else 0.0),
+            "rung_flushes": {str(k): v
+                             for k, v in sorted(self._rung_flushes.items())
+                             if v},
+            "warmup_compiles": self.warmup_compiles,
+            "steady_state_recompiles": self.steady_recompiles(),
+            "est_flush_ms": self._est_s * 1e3,
+            "shed_frac_of_submitted": (self._counts["shed"] / self._next_rid
+                                       if self._next_rid else 0.0),
+        }
+
+
+# ------------------------------------------------------------------ audit
+
+
+def audit_serve_program(rt: ServingRuntime, rung: Optional[int] = None,
+                        expected: Optional[Dict[str, Any]] = None,
+                        expected_donated: Optional[int] = None):
+    """Static census of the compiled serve program (one rung): traces
+    the forward abstractly and enforces the forward-only contract —
+    id + output exchange and NOTHING else (no grad exchange, no psum,
+    never an all_gather), no host interop, no f64. The serving twin of
+    ``audit_train_step``; ``tests/test_serving.py`` and the check drill
+    run it so a pred_fn that quietly pays training-shaped communication
+    per request cannot ship.
+
+    Input donation is reported but not required by default
+    (``expected_donated=None``): it is best-effort — a backend that
+    cannot alias an int32 id buffer into the f32 predictions drops the
+    marker at lowering (the CPU proxy always does), which is a missed
+    optimization, not a correctness hole. Pass the donated leaf count
+    to enforce it on a backend where aliasing is expected to stick."""
+    from ..analysis import audit as audit_mod
+
+    rung = rung or rt.rungs[0]
+    cats, batch, _ = rt._zero_inputs(rung)
+    args: tuple = (rt.state, cats, batch)
+    if rt.streaming_state is not None:
+        args = args + (rt.streaming_state,)
+    if expected is None:
+        expected = audit_mod.expected_eval_collectives(rt.de)
+    return audit_mod.audit_step_fn(
+        rt._eval, args, world=rt.world, dp_input=rt.de.dp_input,
+        expected=expected, expected_donated=expected_donated,
+        label=f"serve_rung{rung}")
+
+
+# ---------------------------------------------------- load gen + driving
+
+
+def synthetic_request(rng: np.random.Generator, table_sizes: Sequence[int],
+                      n: int, *, numerical: int = 0,
+                      ragged: Sequence[int] = (),
+                      ragged_hotness: int = 4,
+                      alpha: float = 1.05,
+                      id_offset: int = 0,
+                      priority: int = 0) -> Request:
+    """One seeded Zipfian request: ``n`` samples of power-law ids per
+    table (``ragged`` table indices get variable-length id lists up to
+    ``ragged_hotness``), plus an ``[n, numerical]`` dense block when
+    ``numerical`` > 0. ``id_offset`` shifts ids (streaming-table
+    drills feed external-id spaces through it)."""
+    from ..utils.data import power_law_ids
+
+    cats: List[Any] = []
+    for i, v in enumerate(table_sizes):
+        if i in ragged:
+            lens = rng.integers(0, ragged_hotness + 1, size=n)
+            cats.append([
+                list(power_law_ids(rng, v, (int(k),), alpha=alpha)
+                     + id_offset) for k in lens])
+        else:
+            cats.append(np.asarray(
+                power_law_ids(rng, v, (n,), alpha=alpha) + id_offset,
+                np.int32))
+    batch = (np.asarray(rng.normal(size=(n, numerical)), np.float32)
+             if numerical else None)
+    return Request(cats=cats, batch=batch, priority=priority)
+
+
+def drive(rt: ServingRuntime, make_request: Callable[[int], Request],
+          qps: float, duration_s: float, *,
+          burst_positions: Optional[Sequence[int]] = None,
+          burst_x: Optional[float] = None,
+          drain_s: float = 10.0) -> List[ServeResult]:
+    """Real-time load loop the tools share: submit ``make_request(i)``
+    at a fixed ``qps`` for ``duration_s`` seconds, polling the runtime
+    between arrivals, then drain.
+
+    ``burst_positions`` (default: :func:`~..utils.runtime.burst_steps`
+    — the ``DETPU_FAULT=burst@<pos>`` drill) names whole seconds of the
+    stream during which the arrival rate multiplies by ``burst_x``
+    (default ``DETPU_SERVE_BURST_X``) — the QPS-spike injection,
+    deterministic per position: the same positions always spike, only
+    wall-clock jitter differs run to run.
+
+    The loop is OPEN-LOOP: every arrival whose time has passed is
+    submitted before the next poll, however long the previous flush
+    took — a slow backend therefore piles real pressure onto the
+    runtime's queue (where the admission controller must bound it)
+    instead of silently stalling the generator (which would make any
+    overload unmeasurable)."""
+    if burst_positions is None:
+        burst_positions = runtime_mod.burst_steps()
+    burst = set(int(p) for p in burst_positions)
+    if burst_x is None:
+        burst_x = envvars.get_float("DETPU_SERVE_BURST_X")
+    arrivals: List[float] = []
+    t = 0.0
+    while t < duration_s:
+        rate = qps * (burst_x if int(t) in burst else 1.0)
+        arrivals.append(t)
+        t += 1.0 / rate
+    results: List[ServeResult] = []
+    start = rt._clock()
+    i = 0
+    while i < len(arrivals):
+        now = rt._clock() - start
+        while i < len(arrivals) and arrivals[i] <= now:
+            rej = rt.submit(make_request(i))
+            if rej is not None:
+                results.append(rej)
+            i += 1
+        results.extend(rt.poll())
+        if i < len(arrivals):
+            wait = arrivals[i] - (rt._clock() - start)
+            if wait > 0:
+                time.sleep(min(0.0005, wait))  # poll tick, 0.5 ms cap
+    deadline = rt._clock() + drain_s
+    while rt.queued_samples and rt._clock() < deadline:
+        results.extend(rt.poll())
+        time.sleep(0.0005)
+    results.extend(rt.poll())
+    return results

@@ -871,7 +871,8 @@ def make_hybrid_train_loop(de: DistributedEmbedding,
 def make_hybrid_eval_step(de: DistributedEmbedding,
                           pred_fn: Callable,
                           mesh=None,
-                          dynamic=None):
+                          dynamic=None,
+                          donate_inputs: bool = False):
     """Build ``eval_step(state, cat_inputs, batch) -> global predictions``.
 
     The inference analogue of :func:`make_hybrid_train_step` — the reference
@@ -892,6 +893,13 @@ def make_hybrid_eval_step(de: DistributedEmbedding,
         shared bucket; no admissions, no state mutation (the state is
         not donated), so interleaved eval never perturbs the training
         trajectory.
+      donate_inputs: donate the ``cat_inputs`` / ``batch`` argument
+        buffers to the compiled forward — the serving-runtime mode
+        (:mod:`.serving`): each flush builds fresh padded input arrays,
+        so their buffers are dead the moment the step consumes them and
+        XLA may reuse them in place. The state (and any streaming
+        state) is NEVER donated — it must survive every call. Leave off
+        for interactive eval where callers re-feed the same arrays.
     """
     from . import streaming as streaming_mod
 
@@ -911,8 +919,10 @@ def make_hybrid_eval_step(de: DistributedEmbedding,
                            False))
             return pred_fn(state.dense_params, outs, batch)
 
+    # inputs only: the state (and streaming state) must survive calls
+    donate = (1, 2) if donate_inputs else ()
     if world == 1:
-        return jax.jit(local_eval)
+        return jax.jit(local_eval, donate_argnums=donate)
     if mesh is None:
         raise ValueError("mesh is required for world_size > 1")
     ax = de.axis_name
@@ -926,7 +936,7 @@ def make_hybrid_eval_step(de: DistributedEmbedding,
         local_eval, mesh=mesh,
         in_specs=in_specs,
         out_specs=P(ax))
-    return jax.jit(sm)
+    return jax.jit(sm, donate_argnums=donate)
 
 
 def init_hybrid_state(de: DistributedEmbedding, emb_optimizer,

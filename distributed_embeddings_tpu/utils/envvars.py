@@ -195,6 +195,47 @@ declare("DETPU_MICROBATCH_BENCH", default="2",
             "DETPU_MICROBATCH so a bench run never inherits a training "
             "run's K")
 
+# deadline-bounded serving runtime (parallel/serving.py +
+# tools/serve_bench.py / tools/check_serving.py = make check-serving)
+declare("DETPU_SERVE_BURST_X", default="8",
+        doc="arrival-rate multiplier of the burst@<pos> QPS-spike drill "
+            "(the serving load generator applies it during each burst "
+            "second; the admission controller must absorb the spike)")
+declare("DETPU_SERVE_DEADLINE_MS", default="100",
+        doc="default per-request deadline (ms, from submit): the "
+            "scheduler flushes early to make it, drops requests already "
+            "past it (typed Expired, counted deadline_missed) instead "
+            "of wasting a rung on answers nobody is waiting for; "
+            "requests may pin their own deadline_ms")
+declare("DETPU_SERVE_MAX_BATCH", default="256",
+        doc="largest padded-batch rung (global samples per flush) of "
+            "the serving coalescer's compiled-executable ladder")
+declare("DETPU_SERVE_MAX_QUEUE", default="1024",
+        doc="hard admission bound (queued samples): a submit that would "
+            "exceed it is shed with a typed Overloaded response — queue "
+            "growth is bounded by construction, whatever the QPS")
+declare("DETPU_SERVE_MAX_WAIT_MS", default="5",
+        doc="batching delay: a queued request is flushed no later than "
+            "this many ms after submit even when the batch is not full "
+            "(the degradation ladder shrinks it to 0 under pressure)")
+declare("DETPU_SERVE_RUNGS", default="",
+        doc="comma-separated explicit padded-batch ladder (global "
+            "samples, ascending, each divisible by the world size) "
+            "overriding the power-of-two default; one compiled "
+            "executable per rung, warmed up front so steady-state "
+            "serving never recompiles")
+declare("DETPU_SERVE_SHED_FRAC", default="0.5",
+        doc="queue fraction of DETPU_SERVE_MAX_QUEUE at which the "
+            "admission controller enters its shed level: new lowest-"
+            "priority (<= 0) requests are refused with a typed "
+            "Overloaded response while higher-priority traffic keeps "
+            "being served")
+declare("DETPU_SERVE_SLO_MS", default="2000",
+        doc="p99 latency bound (ms) the make check-serving overload "
+            "drill enforces on served requests — generous on the CPU "
+            "proxy (flushes are injected 20+ ms slow there); tighten "
+            "per deployment for a real SLO")
+
 # non-finite guard (utils/obs.py + parallel/trainer.py + resilient.py)
 declare("DETPU_NANGUARD", default="1",
         doc="on-device non-finite guard in the hybrid step; 0 = build the "
@@ -247,7 +288,12 @@ declare("DETPU_FAULT", default="",
             "oovflood@<pos> (replace that batch's categorical ids with a "
             "burst of never-before-seen ids — the non-stationary-traffic "
             "drill the streaming-vocab admission/bucket machinery must "
-            "absorb without recompiles or crashes)")
+            "absorb without recompiles or crashes), or burst@<pos> (QPS "
+            "spike: the serving load generator multiplies the arrival "
+            "rate by DETPU_SERVE_BURST_X during that second of the "
+            "stream — the overload drill the serving runtime's "
+            "degradation ladder must absorb with clean typed shedding, "
+            "bounded p99, and post-burst recovery)")
 declare("DETPU_ON_MISMATCH", default="reshard",
         doc="resilient-driver restore policy when a checkpoint's recorded "
             "sharding plan/world size differs from the model's: 'reshard' "

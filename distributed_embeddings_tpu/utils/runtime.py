@@ -140,11 +140,12 @@ def _fault_specs() -> List[Tuple[str, str, Optional[str]]]:
         item = item.strip()
         if not item:
             continue
-        if (item.startswith(("preempt@", "nan@", "badbatch@", "oovflood@"))
+        if (item.startswith(("preempt@", "nan@", "badbatch@", "oovflood@",
+                             "burst@"))
                 or item == "corrupt@ckpt"):
             continue  # driver/checkpoint-level drills: see preempt_step(),
-            # nan_steps(), badbatch_steps(), oovflood_steps() and
-            # corrupt_ckpt_requested()
+            # nan_steps(), badbatch_steps(), oovflood_steps(),
+            # burst_steps() and corrupt_ckpt_requested()
         parts = item.split(":", 2)
         if len(parts) < 2:
             logger.warning("ignoring malformed %s entry %r", FAULT_ENV, item)
@@ -221,6 +222,21 @@ def oovflood_steps() -> Tuple[int, ...]:
     STREAM positions (like ``nan@``/``badbatch@``) so rollback replays
     re-inject deterministically."""
     return _at_steps("oovflood")
+
+
+def burst_steps() -> Tuple[int, ...]:
+    """Positions of ``DETPU_FAULT=burst@<pos>`` drills: at each of those
+    positions of a serving request stream (whole seconds since the stream
+    started) the load generator multiplies the arrival rate by
+    ``DETPU_SERVE_BURST_X`` — the QPS-spike chaos drill the serving
+    runtime's admission controller (``parallel/serving.py``) must absorb
+    by walking its degradation ladder: shrink the batching delay, then
+    shed lowest-priority requests with a typed ``Overloaded`` response —
+    never unbounded queue growth, never a crash, and normal service must
+    resume once the burst passes. Deterministic per position (the drill
+    decides WHEN the spike hits; the stream contents stay the seeded
+    Zipfian draw), parsed per call like the other fault specs."""
+    return _at_steps("burst")
 
 
 def corrupt_ckpt_requested() -> bool:

@@ -139,6 +139,15 @@ flags.DEFINE_enum("plan_audit", "off", ["off", "warn", "strict"],
 flags.DEFINE_string("plan_audit_chip", "v5e",
                     "capacity-registry chip the preflight contract binds "
                     "to (see analysis.plan_audit.CHIP_SPECS)")
+flags.DEFINE_float("serve_qps", 0,
+                   "after training, serve a Zipfian request stream from "
+                   "the trained model at this rate through the "
+                   "deadline-bounded ServingRuntime (parallel/serving.py) "
+                   "and print p50/p95/p99 + shed/pad stats — the "
+                   "inference half of the example (0 = off; "
+                   "single-process runs only)")
+flags.DEFINE_float("serve_seconds", 5,
+                   "duration of the --serve_qps stream")
 flags.DEFINE_enum("param_dtype", "float32", ["float32", "bfloat16"],
                   "embedding table (slab) dtype. bfloat16 halves per-rank "
                   "HBM and a2a activation payloads — the dtype the "
@@ -426,6 +435,39 @@ def main(_):
         auc = evaluate(state)
         if is_chief:
             print(f"Evaluation completed, AUC: {auc}")
+
+    if FLAGS.serve_qps > 0 and nproc == 1 and use_mp_input:
+        print("serving epilogue skipped: the ServingRuntime coalesces "
+              "data-parallel requests — rerun with --dp_input")
+    elif FLAGS.serve_qps > 0 and nproc == 1:
+        # inference epilogue: the deadline-bounded serving runtime over
+        # the JUST-TRAINED state — variable-size Zipfian requests
+        # coalesce into the padded-batch ladder (warmed up front, zero
+        # steady-state recompiles), overload sheds typed
+        from distributed_embeddings_tpu.parallel import (ServeConfig,
+                                                         ServingRuntime)
+        from distributed_embeddings_tpu.parallel import serving as sv
+
+        rt = ServingRuntime(
+            de, lambda dp, outs, n: jax.nn.sigmoid(
+                dense.apply(dp, n, outs))[:, 0],
+            state, mesh=mesh, config=ServeConfig())
+        srng = np.random.default_rng(2)
+        tmpl = sv.synthetic_request(
+            srng, table_sizes, 2,
+            numerical=FLAGS.num_numerical_features)
+        rt.warmup((tmpl.cats, tmpl.batch))
+        sv.drive(rt, lambda i: sv.synthetic_request(
+                     srng, table_sizes, int(srng.integers(1, 9)),
+                     numerical=FLAGS.num_numerical_features),
+                 FLAGS.serve_qps, FLAGS.serve_seconds)
+        s = rt.stats()
+        print(f"serving: {s['served']} served at {FLAGS.serve_qps:.0f} "
+              f"QPS target — p50/p95/p99 = {s['latency_p50_ms']:.1f}/"
+              f"{s['latency_p95_ms']:.1f}/{s['latency_p99_ms']:.1f} ms, "
+              f"shed={s['shed']}, deadline_missed={s['deadline_missed']}, "
+              f"pad={s['pad_fraction']:.2f}, "
+              f"recompiles={s['steady_state_recompiles']}")
 
     # every process participates in the chunked gather; rank 0 writes
     # (reference main.py:246-248 there)

@@ -283,6 +283,30 @@ def test_encodings_from_inputs_errors():
         pa.audit_plan(st, 16, optimizer="rmsprop")
 
 
+def test_price_int8_serving_pricing_only():
+    """The ISSUE-15 serving-table variant: int8 rows + per-row scales
+    price at ~4x less HBM than fp32 (~2x vs bf16, minus the scale tax)
+    and shrink the out-a2a payload by the same code/scale arithmetic —
+    pricing only, nothing materializes, no jax touched."""
+    st = DistEmbeddingStrategy(
+        [{"input_dim": 10_000, "output_dim": 32}] * 8, 8)
+    rec = pa.price_int8_serving(st, 64, param_dtype="float32")
+    # fp32 dim-32: 128 B/row -> 36 B/row = 3.56x
+    assert rec["table_bytes_ratio"] == pytest.approx(128 / 36)
+    assert rec["int8_table_bytes_per_rank"] < rec["table_bytes_per_rank"]
+    assert rec["int8_hbm_frac"] < rec["hbm_frac"]
+    assert rec["out_a2a_bytes_per_step"] > 0
+    assert rec["int8_out_a2a_bytes_per_step"] \
+        < rec["out_a2a_bytes_per_step"]
+    assert rec["out_a2a_ratio"] > 1.0
+    # bf16 baseline halves the win but the variant still wins
+    rec16 = pa.price_int8_serving(st, 64, param_dtype="bfloat16")
+    assert 1.0 < rec16["table_bytes_ratio"] < rec["table_bytes_ratio"]
+    # json-able (rides the bench serving section)
+    import json
+    json.dumps(rec)
+
+
 def test_report_json_roundtrip():
     de, cats, _, _, _ = build_case("ragged", WORLD, 16)
     rep = pa.audit_plan(de, 16, cat_inputs=cats,

@@ -664,6 +664,20 @@ def test_oovflood_fault_parsing(monkeypatch):
     assert runtime.oovflood_steps() == ()
 
 
+def test_burst_fault_parsing(monkeypatch):
+    monkeypatch.setenv(runtime.FAULT_ENV, "burst@2,oovflood@3,raise:x:1")
+    assert runtime.burst_steps() == (2,)
+    assert runtime.oovflood_steps() == (3,)
+    # the @-entry must not confuse the mode:point parser
+    assert ("raise", "x", "1") in runtime._fault_specs()
+    monkeypatch.setenv(runtime.FAULT_ENV, "burst@1, burst@4 ")
+    assert runtime.burst_steps() == (1, 4)
+    monkeypatch.setenv(runtime.FAULT_ENV, "burst@soon")
+    assert runtime.burst_steps() == ()
+    monkeypatch.delenv(runtime.FAULT_ENV)
+    assert runtime.burst_steps() == ()
+
+
 def test_oovflood_injects_fresh_ids(monkeypatch):
     """The oovflood drill swaps a batch's integer leaves for a burst of
     never-before-seen ids — distinct within the burst, deterministic per

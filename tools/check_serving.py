@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""Verify gate for the deadline-bounded serving runtime (run by ``make
+check-serving`` inside ``make verify``) — the overload drill.
+
+CPU end-to-end, one child process on the 8-virtual-device mesh:
+
+1. the child builds an 8-table model (one STREAMING table serving
+   read-only — cold external ids degrade to their shared buckets while
+   being served, nothing about the slot map may change), warms the
+   padded-batch ladder, audits the compiled serve program (forward-only
+   collective contract, no host interop), and drives a seeded Zipfian
+   request stream under ``DETPU_FAULT=slow:serve_step:<s>,burst@<pos>``:
+   every flush is injected slow (the degraded-backend drill) and during
+   second ``<pos>`` the arrival rate spikes ``DETPU_SERVE_BURST_X``-fold
+   (the QPS-spike drill);
+2. the burst must drive the admission controller up its degradation
+   ladder: the queue stays bounded, low-priority requests are shed with
+   typed ``Overloaded`` responses (no crash, no exception, no recompile
+   storm), ``serve_degraded``/``serve_recovered`` events fire, and
+   HIGH-priority requests submitted during the burst keep being served;
+3. after the burst the runtime must RECOVER: the ladder returns to
+   healthy, a fresh tail of normal-rate requests is served in full, the
+   p99 over all served requests stays under ``DETPU_SERVE_SLO_MS``, the
+   streaming state is bitwise-unchanged, and the steady-state recompile
+   count is ZERO across the whole drill.
+
+Exit 0 when the drill passes; 1 with a readable reason otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WORLD = 8
+BURST_AT = 2      # second of the stream the QPS spike hits
+BURST_X = 16      # arrival-rate multiplier during the burst
+SLOW_S = 0.05     # injected per-flush latency (the degraded backend)
+QPS = 40.0        # normal arrival rate (well within capacity)
+DURATION_S = 4.0
+
+_CHILD = """
+import sys
+sys.path.insert(0, {repo!r})
+import numpy as np, jax, jax.numpy as jnp, optax
+jax.config.update('jax_platforms', 'cpu')
+from jax.sharding import Mesh
+from distributed_embeddings_tpu.parallel import (
+    DistributedEmbedding, ServeConfig, ServingRuntime, SparseSGD,
+    StreamingConfig, init_hybrid_state, init_streaming)
+from distributed_embeddings_tpu.parallel import serving as sv
+from distributed_embeddings_tpu.utils import obs
+
+world = {world}
+mesh = Mesh(np.array(jax.devices()[:world]), ("data",))
+sizes = [20000, 10000, 10000, 5000, 5000, 2000, 2000]
+configs = ([{{"input_dim": v, "output_dim": 8}} for v in sizes]
+           + [{{"input_dim": 64 + 16, "output_dim": 8,
+                "streaming": {{"capacity": 64, "buckets": 16}}}}])
+de = DistributedEmbedding(configs, world_size=world)
+scfg = StreamingConfig(admit_min_count=2, evict_margin=1, depth=2,
+                       buckets=256)
+tx = optax.sgd(0.05)
+state = init_hybrid_state(de, SparseSGD(),
+                          {{"w": jnp.ones((8 * len(configs) + 2, 1),
+                                          jnp.float32) * 0.01}},
+                          tx, jax.random.key(0), mesh=mesh)
+sstate = init_streaming(de, scfg, mesh=mesh)
+
+def pred_fn(dp, outs, batch):
+    x = jnp.concatenate(list(outs) + [batch], axis=-1)
+    return jax.nn.sigmoid(x @ dp["w"])[:, 0]
+
+cfg = ServeConfig(max_batch=32, max_wait_ms=5, deadline_ms=2000,
+                  max_queue=64, shed_frac=0.5)
+rt = ServingRuntime(de, pred_fn, state, mesh=mesh, config=cfg,
+                    streaming=(scfg, sstate))
+rng = np.random.default_rng(0)
+table_sizes = sizes + [1]  # streaming input draws external ids below
+tmpl = sv.synthetic_request(rng, table_sizes, 2, numerical=2)
+rt.warmup((tmpl.cats, tmpl.batch))
+stream_before = jax.tree.map(np.asarray, rt.streaming_state)
+
+rep = sv.audit_serve_program(rt)
+if rep.violations:
+    print("AUDIT_FAIL", "; ".join(rep.violations), flush=True)
+    sys.exit(3)
+
+def make_request(i):
+    n = int(rng.integers(1, 5))
+    req = sv.synthetic_request(rng, sizes, n, numerical=2)
+    # streaming table input: EXTERNAL ids far outside any vocab — the
+    # read-only remap must serve them from the shared buckets
+    req.cats = list(req.cats) + [np.asarray(
+        rng.integers(0, 1 << 30, size=(n,)), np.int32)]
+    # every 8th request is high-priority: it must survive the shed level
+    req.priority = 1 if i % 8 == 0 else 0
+    return req
+
+results = sv.drive(rt, make_request, {qps}, {duration},
+                   burst_x={burst_x})
+
+# recovery tail: fresh normal-rate requests after the burst must ALL be
+# served from a healthy ladder
+tail = sv.drive(rt, make_request, {qps}, 1.0, burst_positions=())
+tail_served = sum(1 for r in tail if isinstance(r, sv.Served))
+tail_total = len(tail)
+
+stream_after = jax.tree.map(np.asarray, rt.streaming_state)
+stream_clean = all(
+    np.array_equal(a, b)
+    for a, b in zip(jax.tree.leaves(stream_before),
+                    jax.tree.leaves(stream_after)))
+ev_deg = obs.counters().get("event_serve_degraded", 0)
+ev_rec = obs.counters().get("event_serve_recovered", 0)
+s2 = rt.stats()
+print("FINAL",
+      "SERVED", s2["served"], "SHED", s2["shed"],
+      "EXPIRED", s2["expired"],
+      "DEADLINE_MISSED", s2["deadline_missed"],
+      "P99", round(s2["latency_p99_ms"] or -1, 1),
+      "PAD", round(s2["pad_fraction"], 3),
+      "DEGRADED", ev_deg, "RECOVERED", ev_rec,
+      "LEVEL", s2["level"],
+      "TAIL_SERVED", tail_served, "TAIL_TOTAL", tail_total,
+      "STREAM_CLEAN", int(stream_clean),
+      "STEADY", s2["steady_state_recompiles"], flush=True)
+"""
+
+
+def main() -> int:
+    from distributed_embeddings_tpu.utils import envvars
+
+    slo_ms = envvars.get_float("DETPU_SERVE_SLO_MS")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for k in ("DETPU_OBS", "DETPU_TELEMETRY"):
+        env.pop(k, None)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + f" --xla_force_host_platform_device_count={WORLD}")
+    env["DETPU_FAULT"] = f"slow:serve_step:{SLOW_S},burst@{BURST_AT}"
+    env["DETPU_SERVE_BURST_X"] = str(BURST_X)
+    code = _CHILD.format(repo=REPO, world=WORLD, qps=QPS,
+                         duration=DURATION_S, burst_x=BURST_X)
+    p = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=900)
+    if p.returncode != 0:
+        return _fail([f"drill child failed rc={p.returncode}: "
+                      f"{(p.stderr or p.stdout).strip()[-1200:]}"])
+    got = None
+    for line in reversed(p.stdout.strip().splitlines()):
+        if line.startswith("FINAL"):
+            parts = line.split()
+            got = dict(zip(parts[1::2], parts[2::2]))
+            break
+    if got is None:
+        return _fail(["drill child printed no FINAL line: "
+                      f"{p.stdout.strip()[-800:]}"])
+    errors = []
+    if int(got.get("SERVED", 0)) <= 0:
+        errors.append("no requests were served at all")
+    if int(got.get("SHED", 0)) <= 0:
+        errors.append(
+            "the burst shed nothing — the admission controller never "
+            "engaged (queue growth was unbounded or the spike fizzled)")
+    if int(got.get("DEGRADED", 0)) < 1 or int(got.get("RECOVERED", 0)) < 1:
+        errors.append(
+            f"degradation ladder events missing (degraded="
+            f"{got.get('DEGRADED')}, recovered={got.get('RECOVERED')}) — "
+            "transitions must be observable, not silent")
+    p99 = float(got.get("P99", -1))
+    if not (0 <= p99 <= slo_ms):
+        errors.append(
+            f"p99 over served requests is {p99} ms — outside the "
+            f"{slo_ms:.0f} ms bound (DETPU_SERVE_SLO_MS): shedding did "
+            "not keep the served path's latency bounded")
+    if int(got.get("LEVEL", 1)) != 0:
+        errors.append(
+            f"runtime ended at level {got.get('LEVEL')} — no post-burst "
+            "recovery to healthy")
+    if int(got.get("TAIL_SERVED", 0)) != int(got.get("TAIL_TOTAL", -1)):
+        errors.append(
+            f"post-burst tail served {got.get('TAIL_SERVED')}/"
+            f"{got.get('TAIL_TOTAL')} — normal service did not resume "
+            "after the burst")
+    if got.get("STREAM_CLEAN") != "1":
+        errors.append(
+            "the read-only streaming state CHANGED during serving — "
+            "slot map/sketch must be bitwise-unchanged by any traffic")
+    if got.get("STEADY") != "0":
+        errors.append(
+            f"{got.get('STEADY')} steady-state recompile(s) — the "
+            "request mix retraced the compiled ladder (recompile storm)")
+    if errors:
+        return _fail(errors)
+    print(f"check_serving: OK (burst@{BURST_AT}s x{BURST_X} under "
+          f"slow:serve_step:{SLOW_S}: served {got['SERVED']}, shed "
+          f"{got['SHED']} typed, p99 {got['P99']} ms <= {slo_ms:.0f} ms, "
+          f"{got['DEGRADED']} degraded/{got['RECOVERED']} recovered "
+          f"events, post-burst tail {got['TAIL_SERVED']}/"
+          f"{got['TAIL_TOTAL']} served, streaming state bitwise clean, "
+          "0 steady-state recompiles)")
+    return 0
+
+
+def _fail(errors) -> int:
+    for e in errors:
+        print(f"check_serving: {e}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,7 +27,9 @@ Accepts either the driver's wrapper format (``{"rc": ..., "parsed":
   schedule and phase-profile gates run twice, once for the serialized
   headline and once for the pipelined twins (``schedule_pipelined`` /
   ``phase_profile_pipelined``), so the K-microbatch step's won overlap
-  ratchets independently;
+  ratchets independently — or a ``serving`` regression: fixed-QPS p95
+  latency growing beyond 10%, a recompiling padded-batch ladder, or
+  the section missing versus the baseline (:func:`check_serving`);
 * 2 — unusable inputs (missing file, no parseable payload).
 
 Metrics present in only one record are reported but never fail the gate
@@ -521,6 +523,56 @@ def check_streaming(old: Dict[str, Any], new: Dict[str, Any]) -> int:
     return failures
 
 
+#: max tolerated growth of the serving section's p95 latency (the
+#: latency twin of the 10% throughput gate: at a FIXED target QPS and
+#: fixed shapes, p95 rising faster than this is a served-path
+#: regression, not load)
+SERVING_P95_TOL = 0.10
+
+
+def check_serving(old: Dict[str, Any], new: Dict[str, Any]) -> int:
+    """Gate the ``serving`` section (ISSUE 15): three checks.
+
+    * a nonzero ``steady_state_recompiles`` inside the section fails
+      outright — a padded-batch ladder that retraces per request mix
+      measures compiles, not latencies (the section's count also folds
+      into the record-wide recompile gate, but a candidate diffed
+      against a pre-serving baseline must not escape it);
+    * ``latency_p95_ms`` growing beyond :data:`SERVING_P95_TOL` versus
+      the baseline fails — the fixed-QPS latency ratchet;
+    * a candidate missing the section while the baseline has it fails
+      (the serving scenario failed or was dropped — silence would hide
+      exactly the regressions the gate exists to catch).
+    """
+    sec = new.get("serving")
+    if not isinstance(sec, dict):
+        if isinstance(old.get("serving"), dict):
+            print("compare_bench: candidate has no 'serving' section "
+                  "but the baseline does — the serving scenario failed "
+                  "or was dropped", file=sys.stderr)
+            return 1
+        return 0
+    failures = 0
+    rc = sec.get("steady_state_recompiles")
+    if isinstance(rc, (int, float)) and rc > 0:
+        print(f"compare_bench: serving section recompiled {int(rc)} "
+              "time(s) at steady state — the compiled ladder retraces "
+              "under the benched request mix; its latencies measure "
+              "compiles", file=sys.stderr)
+        failures += 1
+    osec = old.get("serving")
+    if isinstance(osec, dict):
+        op, np_ = osec.get("latency_p95_ms"), sec.get("latency_p95_ms")
+        if isinstance(op, (int, float)) and isinstance(np_, (int, float)) \
+                and op > 0 and np_ > op * (1.0 + SERVING_P95_TOL):
+            print(f"compare_bench: serving REGRESSION: p95 latency "
+                  f"{op:.1f} -> {np_:.1f} ms "
+                  f"(+{(np_ / op - 1) * 100:.1f}%) at fixed QPS — the "
+                  "served path got slower", file=sys.stderr)
+            failures += 1
+    return failures
+
+
 def compare(old: Dict[str, Any], new: Dict[str, Any],
             threshold: float) -> int:
     steady_failures = check_steady_state(new)
@@ -532,6 +584,7 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
     steady_failures += check_phase_profile(old, new,
                                            key="phase_profile_pipelined")
     steady_failures += check_streaming(old, new)
+    steady_failures += check_serving(old, new)
     regressions = 0
     rows = []
     for keys, higher_better in ((THROUGHPUT_KEYS, True), (MS_KEYS, False)):
