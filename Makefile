@@ -3,11 +3,11 @@
 
 SHELL := /bin/bash
 
-.PHONY: all native test test-fast bench bench-diff clean pkg verify \
-        lint plan-audit audit-step hlo-audit schedule-audit check-backend \
-        check-obs check-obs-report check-resilience check-reshard \
-        check-recovery check-streaming check-serving check-phase-profile \
-        obs-report phase-profile
+.PHONY: all native test test-fast bench bench-diff bench-tpu clean pkg \
+        verify lint plan-audit audit-step hlo-audit schedule-audit \
+        check-backend check-obs check-obs-report check-resilience \
+        check-reshard check-recovery check-streaming check-serving \
+        check-online check-phase-profile obs-report phase-profile
 
 all: native
 
@@ -31,7 +31,8 @@ bench:
 # preemption-recovery drill — run before shipping a round
 verify: lint plan-audit audit-step hlo-audit schedule-audit check-backend \
         check-obs check-obs-report check-phase-profile check-resilience \
-        check-reshard check-recovery check-streaming check-serving
+        check-reshard check-recovery check-streaming check-serving \
+        check-online
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -145,12 +146,30 @@ check-streaming:
 check-serving:
 	python tools/check_serving.py
 
+# online learning drill: concurrent train-and-serve in one child under
+# DETPU_FAULT=oovflood@+burst@ (never-seen training ids + an 8x serve
+# spike); requires admissions, typed sheds only, post-burst recovery,
+# monotone snapshot versions, freshness p95 within the SLO, bounded p99,
+# 0 steady-state recompiles, and a training trajectory CRC-identical to
+# the same stream without serving (parallel/online.py)
+check-online:
+	python tools/check_online.py
+
 # optional regression gate: diff two BENCH records, nonzero exit on a >10%
 # throughput regression. Usage: make bench-diff OLD=BENCH_r04.json NEW=out.json
 OLD ?= $(lastword $(sort $(wildcard BENCH_r*.json)))
 NEW ?= BENCH.json
 bench-diff:
 	python tools/compare_bench.py $(OLD) $(NEW)
+
+# one-command real-TPU capture (ROADMAP standing note ii): probe first,
+# fail FAST with the tunnel verdict when the backend is CPU-only, and
+# otherwise run the full bench (headline + pipelined + serving + online
+# sections) stamping the backend platform into the record.
+# Usage: make bench-tpu [OUT=BENCH_tpu.json]
+OUT ?= BENCH_tpu.json
+bench-tpu:
+	python tools/bench_tpu.py --out $(OUT)
 
 pkg:
 	python -m build --wheel 2>/dev/null || pip wheel --no-deps -w dist .

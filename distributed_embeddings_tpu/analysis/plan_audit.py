@@ -347,6 +347,11 @@ class RankBudget:
     # sketch per width slab with a dynamic table; parallel/streaming.py)
     # — rank-uniform like the slabs, 0 for fully-static plans
     streaming_state_bytes: int = 0
+    # the online runtime's RCU double-buffer (parallel/online.py): two
+    # param-slab copies live at the publish instant (published view +
+    # in-flight clone), one frozen opt-shaped slab shared across
+    # versions, and two streaming-state copies — 0 for offline plans
+    snapshot_bytes: int = 0
 
     def to_json(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -438,7 +443,11 @@ class PlanReport:
             + (f" · {self.n_streaming_tables} streaming table(s), "
                f"{self.per_rank[0].streaming_state_bytes / 1e6:.2f} MB/rank "
                "slot-map+sketch state"
-               if self.n_streaming_tables and self.per_rank else ""),
+               if self.n_streaming_tables and self.per_rank else "")
+            + (f" · online RCU snapshots "
+               f"{self.per_rank[0].snapshot_bytes / 1e6:.2f} MB/rank"
+               if self.per_rank and self.per_rank[0].snapshot_bytes
+               else ""),
             "",
             "| rank | tables | live GB | alloc GB | opt GB | a2a buf GB "
             "| total GB | HBM frac |",
@@ -515,12 +524,15 @@ def check_contract(report: PlanReport, contract: PlanContract,
     if contract.max_rank_bytes is not None:
         for r in report.per_rank:
             if r.total_bytes > contract.max_rank_bytes:
+                snap = (f" + online snapshots {_gb(r.snapshot_bytes):.2f}"
+                        if r.snapshot_bytes else "")
                 out.append(
                     f"rank {r.rank}: predicted {_gb(r.total_bytes):.2f} GB "
                     f"(params {_gb(r.alloc_param_bytes):.2f} + opt "
                     f"{_gb(r.opt_state_bytes):.2f} + a2a buffers "
-                    f"{_gb(r.a2a_buffer_bytes):.2f}) exceeds the per-rank "
-                    f"HBM contract {_gb(contract.max_rank_bytes):.2f} GB"
+                    f"{_gb(r.a2a_buffer_bytes):.2f}{snap}) exceeds the "
+                    f"per-rank HBM contract "
+                    f"{_gb(contract.max_rank_bytes):.2f} GB"
                     f" ({contract.reason or report.chip})")
     if contract.max_a2a_bytes_per_step is not None and \
             report.total_a2a_bytes_per_step > contract.max_a2a_bytes_per_step:
@@ -570,7 +582,8 @@ def audit_plan(target,
                chip: str = "v5e",
                label: Optional[str] = None,
                contract: Optional[PlanContract] = None,
-               streaming_config=None) -> PlanReport:
+               streaming_config=None,
+               online: bool = False) -> PlanReport:
     """Price a plan without building it.
 
     Args:
@@ -601,6 +614,16 @@ def audit_plan(target,
         step builder gets via ``dynamic=`` or the per-rank
         ``streaming_state_bytes`` under-/over-bills a non-default
         sketch.
+      online: price the concurrent train-and-serve runtime
+        (``parallel/online.py``): bills the RCU snapshot double-buffer
+        per rank as ``snapshot_bytes`` — two param-slab copies (the
+        published view plus the in-flight clone at the publish
+        instant), ONE opt-shaped frozen slab (the publisher clones
+        optimizer state once and shares the buffers across every
+        version — the serve forward never reads them), and two
+        streaming-state copies. An offline-fitting plan can exceed HBM
+        the moment serving runs beside training; this prices that
+        before building anything.
 
     Nothing executes and nothing is materialized: the heaviest object
     built is the executor's numpy plan tensors (``[world, n]`` per
@@ -689,10 +712,16 @@ def audit_plan(target,
             rows = geom.phys_cap[w] * _pack_factor(w)
             stream_bytes += 2 * rows * 4 + depth * buckets * 4
 
+    # the online runtime's RCU double-buffer (see the `online` arg):
+    # 2x params (published + in-flight) + 1x opt (frozen, shared) +
+    # 2x streaming state — exactly what SnapshotPublisher keeps live
+    snap_bytes = (2 * alloc_rank + opt_rank + 2 * stream_bytes
+                  if online else 0)
+
     spec = CHIP_SPECS[chip]
     per_rank = []
     for r in range(world):
-        total = alloc_rank + opt_rank + a2a_buf + stream_bytes
+        total = alloc_rank + opt_rank + a2a_buf + stream_bytes + snap_bytes
         per_rank.append(RankBudget(
             rank=r, tables=tables_rank[r],
             live_param_bytes=live_rank[r],
@@ -701,7 +730,8 @@ def audit_plan(target,
             a2a_buffer_bytes=a2a_buf,
             total_bytes=total,
             hbm_frac=total / spec.hbm_bytes,
-            streaming_state_bytes=stream_bytes))
+            streaming_state_bytes=stream_bytes,
+            snapshot_bytes=snap_bytes))
 
     slabs = []
     for w in geom.widths:

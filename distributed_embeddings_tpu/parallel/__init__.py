@@ -28,6 +28,14 @@ from .sparse_optax import (
     sparse_value_and_grad,
     unique_ids_static,
 )
+from .online import (
+    OnlineConfig,
+    OnlineResult,
+    OnlineRuntime,
+    Snapshot,
+    SnapshotPublisher,
+    online_sidecar_path,
+)
 from .resilient import (
     PREEMPT_EXIT_CODE,
     ResilientResult,
@@ -59,6 +67,7 @@ from .streaming import (
 )
 from .trainer import (
     HybridTrainState,
+    clone_pytree,
     init_hybrid_state,
     make_hybrid_eval_step,
     make_hybrid_train_loop,

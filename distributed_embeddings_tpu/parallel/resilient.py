@@ -321,6 +321,7 @@ def run_resilient(step_fn: Callable, state, data, *,
                   metrics_logger=None,
                   metrics_interval: int = 100,
                   on_step: Optional[Callable] = None,
+                  on_step_aux: Optional[Callable] = None,
                   exit_on_preempt: bool = False,
                   save_on_exit: bool = True,
                   is_chief: Optional[bool] = None,
@@ -403,6 +404,14 @@ def run_resilient(step_fn: Callable, state, data, *,
       on_step: ``on_step(step, loss, metrics, state) -> stop`` host
         callback after each step (eval cadence, printing, early stop) —
         truthy return stops the loop cleanly.
+      on_step_aux: like ``on_step`` but with the jit-carried aux states
+        appended — ``on_step_aux(step, loss, metrics, state,
+        telemetry_state, streaming_state) -> stop`` (either aux is
+        ``None`` when not threaded). The online runtime's publish-and-
+        serve pump rides here: it needs the streaming state that travels
+        WITH the params to publish a consistent snapshot pair. Called
+        after ``on_step`` when both are given; truthy return stops the
+        loop the same way.
       exit_on_preempt: after the preemption checkpoint+sentinel, call
         ``sys.exit(PREEMPT_EXIT_CODE)`` instead of returning. Ignored
         without ``checkpoint_dir`` — exit code 83 asserts a checkpoint
@@ -941,6 +950,11 @@ def run_resilient(step_fn: Callable, state, data, *,
 
                 if (on_step is not None and not quarantined_now
                         and on_step(cur, last_loss, metrics, state)):
+                    stop_reason = "on_step"
+                    break
+                if (on_step_aux is not None and not quarantined_now
+                        and on_step_aux(cur, last_loss, metrics, state,
+                                        telemetry_state, streaming_state)):
                     stop_reason = "on_step"
                     break
 

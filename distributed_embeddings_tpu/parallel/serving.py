@@ -48,6 +48,18 @@ family:
   ``serve_recovered``) and the served/shed/deadline-missed counts bump
   the process counters next to the recompile counter.
 
+Under the online-learning runtime (``parallel/online.py``) the frozen
+tables become *published snapshots*: :meth:`ServingRuntime.
+install_snapshot` atomically swaps in monotonically-versioned table
+copies between polls (every flush reads the installed view exactly once
+— no torn reads), per-response staleness is tracked next to latency
+(``freshness_p95_steps`` / ``freshness_p95_s`` in :meth:`stats`), and a
+FRESHNESS rung joins the ladder: when publication falls behind
+``DETPU_FRESHNESS_MAX_STEPS`` (or ages past ``DETPU_FRESHNESS_MAX_S``)
+the server sheds low-priority load (typed ``Overloaded``,
+``reason="stale_snapshot"``; ``snapshot_lagging`` event) instead of
+ever blocking training.
+
 Drills: ``DETPU_FAULT=slow:serve_step`` injects latency into every
 flush (the degraded-backend drill) and ``DETPU_FAULT=burst@<pos>``
 makes :func:`drive` spike the arrival rate during second ``<pos>`` of
@@ -222,6 +234,13 @@ class Served(ServeResult):
     predictions: Any = None
     rung: int = 0
     deadline_missed: bool = False  # completed, but after the deadline
+    # online-learning provenance: which published table snapshot answered
+    # (the whole flush observed exactly this one version — never a
+    # mid-publish mix), and how stale it was at flush time. -1 / None =
+    # no snapshot installed (the classic frozen-table server)
+    version: int = -1
+    staleness_steps: Optional[float] = None
+    staleness_s: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -292,6 +311,14 @@ class ServingRuntime:
                 "as data-parallel id shards and ride the id exchange "
                 "(pre-packed MpInputs cannot be coalesced per request)")
         self.rungs = resolve_rungs(self.config, self.world)
+        # the installed (state, streaming_state, snapshot-meta) triple.
+        # ONE reference, swapped atomically by install_snapshot() and read
+        # ONCE per flush — a flush can never observe a mid-publish mix of
+        # versions (the online runtime's no-torn-read contract). meta is
+        # None for the classic frozen-table server, else
+        # (version, train_step, published_t)
+        self._published: Tuple[Any, Any, Optional[Tuple[int, int, float]]] \
+            = (None, None, None)
         self.state = state
         self._clock = clock
         self._streaming_cfg = None
@@ -321,7 +348,140 @@ class ServingRuntime:
         self._counts = {"served": 0, "shed": 0, "deadline_missed": 0,
                         "expired": 0, "failed": 0, "flushes": 0,
                         "served_samples": 0, "ragged_clipped": 0,
-                        "degraded": 0, "recovered": 0}
+                        "degraded": 0, "recovered": 0,
+                        "snapshots_installed": 0, "stale_shed": 0}
+        # freshness SLO state (online learning, parallel/online.py): the
+        # trainer's newest completed step vs the installed snapshot's.
+        # Inert (stale never trips) until a snapshot is installed
+        self._latest_train_step: Optional[int] = None
+        self._stale = False
+        self._freshness_max_steps = envvars.get_int(
+            "DETPU_FRESHNESS_MAX_STEPS")
+        self._freshness_max_s = envvars.get_float("DETPU_FRESHNESS_MAX_S")
+        self._fresh_steps: List[float] = []
+        self._fresh_s: List[float] = []
+
+    # --------------------------------------------- published table views
+
+    @property
+    def state(self):
+        """The train state the compiled forward reads — the currently
+        installed table view (a published snapshot under the online
+        runtime, the construction-time state otherwise)."""
+        return self._published[0]
+
+    @state.setter
+    def state(self, value) -> None:
+        _, ss, meta = self._published
+        self._published = (value, ss, meta)
+
+    @property
+    def streaming_state(self):
+        """Read-only streaming-vocab state of the installed view."""
+        return self._published[1]
+
+    @streaming_state.setter
+    def streaming_state(self, value) -> None:
+        st, _, meta = self._published
+        self._published = (st, value, meta)
+
+    def install_snapshot(self, state, streaming_state=None, *,
+                         version: int, train_step: int,
+                         published_t: Optional[float] = None,
+                         now: Optional[float] = None) -> None:
+        """Atomically swap in one published table view (RCU reader side).
+
+        The online runtime's :class:`~.online.SnapshotPublisher` calls
+        this between polls with freshly copied buffers; ``version`` must
+        be strictly monotonic (a regression raises — the versioning
+        contract, not a recoverable condition). The swap is a single
+        reference assignment and every flush reads the triple exactly
+        once, so a flush observes exactly one version. The arrays must
+        match the warmed-up state's structure/shapes/dtypes bitwise-in-
+        spec, or the compiled ladder would retrace (the 0-steady-state-
+        recompiles contract ``make check-online`` drills)."""
+        now = self._clock() if now is None else now
+        published_t = now if published_t is None else float(published_t)
+        meta = self._published[2]
+        if meta is not None and version <= meta[0]:
+            raise ValueError(
+                f"snapshot version must be monotonic: got {version}, "
+                f"installed {meta[0]}")
+        if self._streaming_cfg is not None and streaming_state is None:
+            raise ValueError(
+                "this runtime serves streaming tables: install_snapshot "
+                "needs the matching streaming_state copy")
+        self._published = (state, streaming_state,
+                           (int(version), int(train_step), published_t))
+        # the snapshot IS the freshest trained view at publish time
+        self._latest_train_step = int(train_step)
+        self._counts["snapshots_installed"] += 1
+        obs.counter_inc("snapshot_published")
+        obs.record_event("snapshot_published", version=int(version),
+                         train_step=int(train_step))
+        self._refresh_staleness(now)
+
+    def note_train_step(self, step: int, now: Optional[float] = None) -> None:
+        """Tell the server how far training has advanced (the freshness
+        reference point). When the installed snapshot falls more than
+        ``DETPU_FRESHNESS_MAX_STEPS`` behind (or ages past
+        ``DETPU_FRESHNESS_MAX_S``), the runtime enters its shed rung —
+        load is refused serve-side (typed, ``reason="stale_snapshot"``)
+        before the trainer is ever blocked on publication."""
+        now = self._clock() if now is None else now
+        if self._latest_train_step is None or step > self._latest_train_step:
+            self._latest_train_step = int(step)
+        self._refresh_staleness(now)
+
+    def set_freshness_slo(self, max_steps: Optional[int] = None,
+                          max_s: Optional[float] = None) -> None:
+        """Override the env-default freshness SLO (the online runtime
+        pushes its :class:`~.online.OnlineConfig` through here so one
+        config governs publisher and server)."""
+        if max_steps is not None:
+            self._freshness_max_steps = int(max_steps)
+        if max_s is not None:
+            self._freshness_max_s = float(max_s)
+
+    def _staleness(self, now: float) -> Optional[Tuple[int, float, float]]:
+        """(version, lag_steps, age_s) of the installed snapshot, or
+        ``None`` when no snapshot was ever installed."""
+        meta = self._published[2]
+        if meta is None:
+            return None
+        version, snap_step, pub_t = meta
+        latest = (self._latest_train_step if self._latest_train_step
+                  is not None else snap_step)
+        return version, max(0, latest - snap_step), max(0.0, now - pub_t)
+
+    def _refresh_staleness(self, now: float) -> None:
+        st = self._staleness(now)
+        if st is None:
+            return
+        version, lag_steps, age_s = st
+        stale = ((self._freshness_max_steps > 0
+                  and lag_steps > self._freshness_max_steps)
+                 or (self._freshness_max_s > 0
+                     and age_s > self._freshness_max_s))
+        if stale and not self._stale:
+            obs.counter_inc("snapshot_lagging")
+            obs.record_event("snapshot_lagging", version=version,
+                             lag_steps=int(lag_steps),
+                             age_s=float(age_s),
+                             max_steps=self._freshness_max_steps,
+                             max_s=self._freshness_max_s)
+            logger.warning(
+                "serving snapshot v%d is STALE (%d step(s) / %.3f s "
+                "behind training) — entering the shed rung", version,
+                lag_steps, age_s)
+        self._stale = stale
+        self._update_level()
+
+    @property
+    def freshness_stale(self) -> bool:
+        """Whether the freshness SLO is currently violated (the shed
+        rung is forced on until the next publication)."""
+        return self._stale
 
     # ------------------------------------------------------------ intake
 
@@ -422,10 +582,17 @@ class ServingRuntime:
         reason = None
         if q + req.n > self.config.max_queue:
             reason = "queue_full"
+        elif self._stale and req.priority <= 0:
+            # freshness rung: publication fell behind the SLO — refuse
+            # low-priority load rather than serve ever-staler answers
+            # (or block training to catch up)
+            reason = "stale_snapshot"
         elif q >= shed_at and req.priority <= 0:
             reason = "load_shed"
         if reason is not None:
             self._counts["shed"] += 1
+            if reason == "stale_snapshot":
+                self._counts["stale_shed"] += 1
             obs.counter_inc("serve_shed")
             self._update_level()
             return Overloaded(rid=req.rid, latency_ms=0.0, reason=reason,
@@ -451,6 +618,11 @@ class ServingRuntime:
     # ------------------------------------------------- degradation ladder
 
     def _target_level(self, q: int) -> int:
+        if self._stale:
+            # the freshness rung rides the same ladder as queue pressure:
+            # serve_degraded/serve_recovered events fire on the
+            # transitions, and recovery is the next publication
+            return 2
         if q >= self.config.shed_frac * self.config.max_queue:
             return 2
         if q >= self.rungs[-1]:
@@ -602,18 +774,23 @@ class ServingRuntime:
             return 0
         return obs.counters().get("recompiles", 0) - self._compiles_at_steady
 
-    def _dispatch(self, cats, batch):
-        if self.streaming_state is not None:
-            return self._eval(self.state, cats, batch,
-                              self.streaming_state)
-        return self._eval(self.state, cats, batch)
+    def _dispatch(self, cats, batch, published=None):
+        state, sstate, _ = (self._published if published is None
+                            else published)
+        if sstate is not None:
+            return self._eval(state, cats, batch, sstate)
+        return self._eval(state, cats, batch)
 
     def _run_flush(self, reqs: List[Request],
                    rung: int) -> List[Served]:
         runtime_mod.fault_point("serve_step")
         t0 = self._clock()
+        # read the published triple ONCE: the whole flush — tables,
+        # streaming state, version stamp — observes exactly this view,
+        # however the publisher interleaves (the no-torn-read contract)
+        published = self._published
         cats, batch, offsets = self._pack(reqs, rung)
-        preds = np.asarray(self._dispatch(cats, batch))
+        preds = np.asarray(self._dispatch(cats, batch, published))
         t1 = self._clock()
         self._est_s = (t1 - t0 if not self._est_s
                        else 0.7 * self._est_s + 0.3 * (t1 - t0))
@@ -624,11 +801,27 @@ class ServingRuntime:
         self._rung_flushes[rung] = self._rung_flushes.get(rung, 0) + 1
         if len(self._lat_ms) > 2 * STATS_WINDOW:
             del self._lat_ms[:-STATS_WINDOW]
+        # per-response freshness: how stale the answering snapshot was at
+        # flush time, in steps (vs the trainer's newest completed step)
+        # and seconds (snapshot age) — the freshness SLO's raw samples
+        meta = published[2]
+        version = -1
+        stale_steps: Optional[float] = None
+        stale_s: Optional[float] = None
+        if meta is not None:
+            version, snap_step, pub_t = meta
+            latest = (self._latest_train_step if self._latest_train_step
+                      is not None else snap_step)
+            stale_steps = float(max(0, latest - snap_step))
+            stale_s = float(max(0.0, t1 - pub_t))
         out = []
         for r, o in zip(reqs, offsets):
             lat = (t1 - r.t_submit) * 1e3
             missed = t1 > r.deadline
             self._lat_ms.append(lat)
+            if meta is not None:
+                self._fresh_steps.append(stale_steps)
+                self._fresh_s.append(stale_s)
             self._counts["served"] += 1
             self._counts["served_samples"] += r.n
             if missed:
@@ -637,7 +830,12 @@ class ServingRuntime:
             obs.counter_inc("serve_served")
             out.append(Served(rid=r.rid, latency_ms=lat,
                               predictions=preds[o:o + r.n], rung=rung,
-                              deadline_missed=missed))
+                              deadline_missed=missed, version=version,
+                              staleness_steps=stale_steps,
+                              staleness_s=stale_s))
+        if len(self._fresh_steps) > 2 * STATS_WINDOW:
+            del self._fresh_steps[:-STATS_WINDOW]
+            del self._fresh_s[:-STATS_WINDOW]
         return out
 
     def poll(self, now: Optional[float] = None) -> List[ServeResult]:
@@ -647,6 +845,12 @@ class ServingRuntime:
         it is cheap when nothing is due."""
         out: List[ServeResult] = []
         explicit = now is not None
+        # the seconds half of the freshness SLO can trip between
+        # publications with no train-step notification — re-evaluate it
+        # on the scheduler tick. Guarded so the classic (no-snapshot)
+        # server keeps its exact clock-read sequence
+        if self._published[2] is not None:
+            self._refresh_staleness(now if explicit else self._clock())
         while True:
             t = now if explicit else self._clock()
             # deadline propagation, part 1: requests already past their
@@ -735,6 +939,9 @@ class ServingRuntime:
         q = np.asarray(self._qdepth, np.float64)
         pct = (lambda p: float(np.percentile(lat, p))) if lat.size \
             else (lambda p: None)
+        fsteps = np.asarray(self._fresh_steps, np.float64)
+        fs = np.asarray(self._fresh_s, np.float64)
+        meta = self._published[2]
         return {
             **self._counts,
             "level": self._level,
@@ -755,6 +962,14 @@ class ServingRuntime:
             "est_flush_ms": self._est_s * 1e3,
             "shed_frac_of_submitted": (self._counts["shed"] / self._next_rid
                                        if self._next_rid else 0.0),
+            # freshness SLO, next to p99 (None until a snapshot serves)
+            "freshness_p95_steps": (float(np.percentile(fsteps, 95))
+                                    if fsteps.size else None),
+            "freshness_p95_s": (float(np.percentile(fs, 95))
+                                if fs.size else None),
+            "snapshot_version": meta[0] if meta is not None else None,
+            "snapshot_train_step": meta[1] if meta is not None else None,
+            "freshness_stale": bool(self._stale),
         }
 
 

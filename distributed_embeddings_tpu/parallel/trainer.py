@@ -955,3 +955,26 @@ def init_hybrid_state(de: DistributedEmbedding, emb_optimizer,
         dense_params=dense_params,
         dense_opt_state=dense_tx.init(dense_params),
         step=jnp.zeros((), jnp.int32))
+
+
+@jax.jit
+def _clone(tree):
+    # a + 0 (same dtype) forces a REAL output buffer per leaf — an
+    # identity would let the runtime hand the input buffer back
+    return jax.tree.map(lambda a: a + jnp.zeros((), a.dtype), tree)
+
+
+def clone_pytree(tree):
+    """Donation-safe deep copy of a jit-carried pytree: fresh device
+    buffers holding the source's values, with dtypes and shardings
+    preserved (the copy is an elementwise jit, so GSPMD keeps each
+    leaf's placement).
+
+    The hybrid train step donates its state every step, so any view
+    that must outlive the step — the online runtime's published serving
+    snapshots (``parallel/online.py``) — has to be a real copy; and the
+    copy must preserve placement so the serving ladder's jit cache keys
+    match across published versions (the 0-steady-state-recompiles
+    contract). One compile per distinct pytree structure/shape set,
+    cache hits thereafter."""
+    return _clone(tree)

@@ -236,6 +236,30 @@ declare("DETPU_SERVE_SLO_MS", default="2000",
             "proxy (flushes are injected 20+ ms slow there); tighten "
             "per deployment for a real SLO")
 
+# online learning runtime: concurrent train-and-serve with RCU snapshot
+# publication and a freshness SLO (parallel/online.py +
+# tools/check_online.py = make check-online)
+declare("DETPU_FRESHNESS_MAX_S", default="0",
+        doc="wall-clock half of the freshness SLO (seconds): when the "
+            "installed serving snapshot's age exceeds it the runtime "
+            "enters its freshness shed rung, like the step half below. "
+            "0 = disabled (step SLO only)")
+declare("DETPU_FRESHNESS_MAX_STEPS", default="8",
+        doc="staleness SLO in train steps: when snapshot publication "
+            "falls more than this many completed steps behind training, "
+            "serving enters its shed rung (new priority<=0 requests are "
+            "refused with a typed Overloaded reason='stale_snapshot', a "
+            "snapshot_lagging event fires) — load is shed serve-side "
+            "before training is ever blocked on publication; the next "
+            "publication recovers. <=0 disables the step SLO")
+declare("DETPU_ONLINE_PUBLISH_STEPS", default="1",
+        doc="publication cadence (train steps) of the online runtime's "
+            "RCU snapshot publisher: every N completed steps the "
+            "training tables are copied into fresh buffers and installed "
+            "atomically as one monotonically-versioned serving view "
+            "(rollback-and-replay republishes immediately, whatever the "
+            "cadence)")
+
 # non-finite guard (utils/obs.py + parallel/trainer.py + resilient.py)
 declare("DETPU_NANGUARD", default="1",
         doc="on-device non-finite guard in the hybrid step; 0 = build the "
@@ -293,7 +317,12 @@ declare("DETPU_FAULT", default="",
             "rate by DETPU_SERVE_BURST_X during that second of the "
             "stream — the overload drill the serving runtime's "
             "degradation ladder must absorb with clean typed shedding, "
-            "bounded p99, and post-burst recovery)")
+            "bounded p99, and post-burst recovery). Specs comma-combine: "
+            "oovflood@P,burst@P is the joint online-learning chaos drill "
+            "(a traffic spike of never-seen ids while serving, make "
+            "check-online); in the online runtime burst@ positions are "
+            "train-step ordinals (requests-per-step multiply by "
+            "DETPU_SERVE_BURST_X at those steps)")
 declare("DETPU_ON_MISMATCH", default="reshard",
         doc="resilient-driver restore policy when a checkpoint's recorded "
             "sharding plan/world size differs from the model's: 'reshard' "

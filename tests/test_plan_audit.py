@@ -195,6 +195,54 @@ def test_seeded_over_hbm_plan_fails_naming_rank():
         rep.raise_on_violations()
 
 
+def test_online_snapshot_billing_and_seeded_over_hbm():
+    """The online runtime's RCU double-buffer is contract-checked:
+    ``online=True`` bills 2x params + 1x opt (frozen, shared) + 2x
+    streaming state per rank as ``snapshot_bytes``, and a plan that
+    fits offline can exceed HBM the moment serving runs beside
+    training — rejected with the snapshot component named."""
+    st = DistEmbeddingStrategy(C1TB_CONFIGS, CRITEO1TB_WORLD,
+                               strategy="comm_balanced",
+                               column_slice_threshold=CRITEO1TB_COL_SLICE)
+    kw = dict(optimizer="adagrad", param_dtype="bfloat16",
+              dp_input=False)
+    off = pa.audit_plan(st, CRITEO1TB_BATCH,
+                        contract=pa.default_contract(), **kw)
+    assert off.ok, off.violations
+    assert all(r.snapshot_bytes == 0 for r in off.per_rank)
+    on = pa.audit_plan(st, CRITEO1TB_BATCH, online=True,
+                       contract=pa.default_contract(), **kw)
+    r0, o0 = on.per_rank[0], off.per_rank[0]
+    # the publisher keeps exactly: published + in-flight params, one
+    # frozen opt slab, two streaming-state copies (zero here)
+    assert r0.snapshot_bytes == (2 * o0.alloc_param_bytes
+                                 + o0.opt_state_bytes
+                                 + 2 * o0.streaming_state_bytes)
+    assert r0.total_bytes == o0.total_bytes + r0.snapshot_bytes
+    # ~6.6 GB/rank offline fits v5e; +2x params +1x opt does not
+    assert not on.ok
+    assert any("online snapshots" in v and "exceeds the per-rank HBM" in v
+               for v in on.violations), on.violations
+
+
+def test_online_snapshot_bills_streaming_state_twice():
+    cfgs = [{"input_dim": 4096 + 256, "output_dim": 16,
+             "streaming": {"capacity": 4096, "buckets": 256}},
+            {"input_dim": 1000, "output_dim": 16}]
+    st = DistEmbeddingStrategy(cfgs, 2)
+
+    class _S:  # duck-typed StreamingConfig (this module stays jax-free)
+        depth, buckets = 3, 512
+
+    off = pa.audit_plan(st, 16, streaming_config=_S)
+    on = pa.audit_plan(st, 16, streaming_config=_S, online=True)
+    o0, r0 = off.per_rank[0], on.per_rank[0]
+    assert o0.streaming_state_bytes > 0
+    assert r0.snapshot_bytes == (2 * o0.alloc_param_bytes
+                                 + o0.opt_state_bytes
+                                 + 2 * o0.streaming_state_bytes)
+
+
 def test_seeded_past_cliff_slab_fails_naming_slab():
     """Criteo-1TB bf16 on 16 ranks WITHOUT column slicing stacks the
     ~40M-row tables into a ~9.5 GB apply slab — past the measured

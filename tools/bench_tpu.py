@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""One-command real-TPU bench capture (``make bench-tpu``).
+
+ROADMAP standing note ii: the pipelined step, the serving runtime and
+the online train-and-serve loop are all landed and gated, but their
+remaining debt is a REAL-TPU capture — the tunnel has been down since
+BENCH_r05 died rc=124 to a pre-probe backend touch. This wrapper makes
+the capture a single command that can be retried cheaply until the
+tunnel returns:
+
+1. probe the backend FIRST (``utils.runtime.probe_backend`` — a watched
+   subprocess with a hard timeout, the r5 fix), and fail FAST with the
+   probe's verdict when the tunnel is down or the backend resolves to
+   anything but TPU (a CPU-proxy record must never be mistaken for the
+   real capture — the BENCH_r04-vs-r05 confusion trap);
+2. only then run the full ``bench.py`` (headline + pipelined + serving
+   + online sections) in a child, stream its progress through, and
+   write the final JSON record — backend stamped by bench itself — to
+   ``--out``.
+
+Exit codes: 0 captured; 2 probe failed (tunnel verdict printed);
+3 backend is not TPU; 1 bench child failed or produced no record.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="BENCH_tpu.json",
+                    help="where to write the captured record "
+                         "(default: %(default)s)")
+    ap.add_argument("--probe-timeout-s", type=float,
+                    default=float(os.environ.get("DETPU_PROBE_TIMEOUT_S",
+                                                 "120")),
+                    help="hard deadline for the first backend touch")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the bench in smoke shapes (wrapper "
+                         "self-test; the record is NOT a capture)")
+    args = ap.parse_args(argv)
+
+    from distributed_embeddings_tpu.utils.runtime import probe_backend
+
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # an inherited CPU pin would make the probe "succeed" on the
+        # wrong backend — surface the real cause instead
+        print("bench_tpu: JAX_PLATFORMS=cpu is set in this environment "
+              "— unset it to reach the TPU", file=sys.stderr)
+        return 3
+
+    probe = probe_backend(timeout_s=args.probe_timeout_s)
+    print(f"bench_tpu: probe verdict: {json.dumps(probe.to_json())}")
+    if not probe.ok:
+        print(f"bench_tpu: backend probe FAILED after "
+              f"{probe.elapsed_s:.1f}s: {probe.error} — the tunnel is "
+              "down; nothing was benched", file=sys.stderr)
+        return 2
+    if probe.platform != "tpu":
+        print(f"bench_tpu: backend resolved to {probe.platform!r} "
+              f"({probe.device_count} device(s)), not TPU — refusing to "
+              "capture a CPU-proxy record under a TPU filename "
+              "(run plain `make bench` for a proxy run)", file=sys.stderr)
+        return 3
+
+    env = dict(os.environ)
+    if args.smoke:
+        env["DETPU_BENCH_SMOKE"] = "1"
+    print(f"bench_tpu: TPU backend up ({probe.device_count} device(s), "
+          f"probe {probe.elapsed_s:.1f}s) — running the full bench")
+    proc = subprocess.Popen([sys.executable, os.path.join(REPO, "bench.py")],
+                            env=env, cwd=REPO, text=True,
+                            stdout=subprocess.PIPE)
+    record = None
+    assert proc.stdout is not None
+    for line in proc.stdout:  # stream progress, remember the JSON line
+        sys.stdout.write(line)
+        sys.stdout.flush()
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            record = obj
+    rc = proc.wait()
+    if rc != 0 or record is None:
+        print(f"bench_tpu: bench child rc={rc}, "
+              f"record={'present' if record else 'MISSING'} — no capture "
+              "written", file=sys.stderr)
+        return 1
+    if record.get("backend") != "tpu":
+        # the child re-probes; a tunnel that died between the probe and
+        # the run yields a record stamped with the wrong backend
+        print(f"bench_tpu: record is stamped backend="
+              f"{record.get('backend')!r} — the tunnel dropped mid-run; "
+              "not writing a TPU capture", file=sys.stderr)
+        return 1
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(record, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"bench_tpu: captured {args.out} "
+          f"(backend=tpu, devices={record.get('device_count')}, "
+          f"headline {record.get('value')} {record.get('unit')})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,252 @@
+#!/usr/bin/env python
+"""Verify gate for the online learning runtime (run by
+``make check-online`` inside ``make verify``) — concurrent
+train-and-serve under the combined chaos drill.
+
+CPU end-to-end, deterministic, no backend required beyond the CPU one:
+
+1. spawn a child running ``parallel.online.OnlineRuntime`` — the
+   resilient streaming-vocab training loop and the serving coalescer in
+   ONE process against ONE set of tables, RCU snapshots published every
+   2 steps — under ``DETPU_FAULT=oovflood@3,burst@5`` (step 3 floods
+   the TRAINING stream with never-seen ids while step 5 multiplies the
+   SERVE arrivals 8x). The child must reach the final step with real
+   slot admissions, real serves, only TYPED sheds, versions that only
+   move forward (no torn snapshot reads — the bitwise pin lives in
+   ``tests/test_online.py``), bounded staleness
+   (``freshness_p95_steps`` within the SLO), bounded p99, and ZERO
+   steady-state recompiles across any mix of training, publication and
+   serving;
+2. run the IDENTICAL training stream withOUT serving (plain
+   ``run_resilient``, same fault env) in a fresh directory and assert
+   both final checkpoints are CRC-identical — concurrent serving must
+   not perturb the training trajectory by a single bit (the publisher
+   copies, serves read copies, and the version record lives in a
+   sidecar BESIDE the checkpoint).
+
+Exit 0 when the drill passes; 1 with a readable reason otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+STEPS = 12
+FLOOD = 3      # training-stream position oovflood@ floods
+BURST = 5      # train-step ordinal burst@ multiplies serve arrivals at
+SLO_STEPS = 4  # freshness SLO the drill must hold (publish cadence 2)
+P99_MS = 5000.0  # sanity ceiling for CPU flushes, not a perf ratchet
+
+_COMMON = """
+import sys
+sys.path.insert(0, {repo!r})
+import jax, optax, numpy as np, jax.numpy as jnp
+jax.config.update('jax_platforms', 'cpu')
+from distributed_embeddings_tpu.parallel import (
+    DistributedEmbedding, SparseAdagrad, StreamingConfig,
+    init_hybrid_state, init_streaming, make_hybrid_train_step)
+from distributed_embeddings_tpu.parallel import streaming as smod
+from distributed_embeddings_tpu.utils import obs
+obs.install_compile_listener()
+configs = [
+    {{"input_dim": 20, "output_dim": 4}},
+    {{"input_dim": 32 + 8, "output_dim": 4,
+      "streaming": {{"capacity": 32, "buckets": 8}}}},
+]
+de = DistributedEmbedding(configs, world_size=1)
+cfg = StreamingConfig(admit_min_count=2, evict_margin=1,
+                      depth=2, buckets=256)
+emb_opt = SparseAdagrad()
+tx = optax.sgd(0.05)
+state = init_hybrid_state(de, emb_opt,
+                          {{"w": jnp.ones((4, 1), jnp.float32)}},
+                          tx, jax.random.key(0))
+sstate = init_streaming(de, cfg)
+def loss_fn(dp, outs, batch):
+    return sum(batch[:, i].mean() * jnp.mean(o)
+               for i, o in enumerate(outs)) * jnp.mean(dp["w"])
+def make_batch(i):
+    rng = np.random.default_rng(900 + i)
+    cats = [jnp.asarray(rng.integers(0, 20, 8), jnp.int32),
+            jnp.asarray(rng.integers(i, i + 6, 8) * 7 + 10_000_000,
+                        jnp.int32)]
+    return cats, jnp.asarray(rng.normal(size=(8, 2)), jnp.float32)
+def data(start):
+    for i in range(start, {steps}):
+        yield make_batch(i)
+step = make_hybrid_train_step(de, loss_fn, tx, emb_opt,
+                              with_metrics=True, nan_guard=True,
+                              dynamic=cfg)
+"""
+
+# the online child: train + publish + serve in one process
+_CHILD_ONLINE = _COMMON + """
+from distributed_embeddings_tpu.parallel import (
+    OnlineConfig, OnlineRuntime, Overloaded, ServeConfig, Served,
+    ServingRuntime)
+from distributed_embeddings_tpu.parallel import serving as sv
+rt = ServingRuntime(de, lambda dp, outs, b:
+                        sum(jnp.sum(o, -1) for o in outs)
+                        + jnp.sum(b, -1),
+                    state,
+                    config=ServeConfig(max_batch=16, max_wait_ms=0,
+                                       deadline_ms=10_000, max_queue=16),
+                    streaming=(cfg, sstate))
+rng = np.random.default_rng(7)
+online = OnlineRuntime(rt, config=OnlineConfig(publish_every_steps=2,
+                                               freshness_max_steps={slo}),
+                       checkpoint_dir={ckpt!r})
+res = online.run(
+    step, state, data, de=de,
+    warmup_template=([np.zeros(2, np.int32), np.zeros(2, np.int32)],
+                     np.zeros((2, 2), np.float32)),
+    make_request=lambda i: sv.synthetic_request(rng, [20, 40], 2,
+                                                numerical=2),
+    requests_per_step=2, streaming_state=sstate, emb_optimizer=emb_opt,
+    dense_tx=tx, checkpoint_every_steps=2, metrics_interval=0)
+occ = smod.occupancy(de, res.train.streaming)
+served = [r for r in res.serve_results if isinstance(r, Served)]
+others = [r for r in res.serve_results if not isinstance(r, Served)]
+untyped = sum(1 for r in others if not isinstance(r, Overloaded))
+vs = [r.version for r in served]
+torn = int(vs != sorted(vs) or any(v < 1 for v in vs))
+s = res.serve_stats
+print("FINAL", res.train.step, "PREEMPTED", int(res.train.preempted),
+      "ADMITTED", int(occ["admitted"]), "SERVED", len(served),
+      "SHED", len(others), "UNTYPED", untyped,
+      "STEADY", s["steady_state_recompiles"], "TORN", torn,
+      "FRESHP95", s["freshness_p95_steps"],
+      "P99", round(s["latency_p99_ms"], 3),
+      "LEVEL", rt.level, "VERSION", res.published_version, flush=True)
+"""
+
+# the offline reference: the SAME training stream, no serving at all
+_CHILD_OFFLINE = _COMMON + """
+from distributed_embeddings_tpu.parallel import run_resilient
+r = run_resilient(step, state, data, de=de, checkpoint_dir={ckpt!r},
+                  checkpoint_every_steps=2, resume=True,
+                  emb_optimizer=emb_opt, dense_tx=tx,
+                  streaming_state=sstate, metrics_interval=0)
+print("FINAL", r.step, "PREEMPTED", int(r.preempted), flush=True)
+"""
+
+
+def _run_child(code, fault=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for k in ("DETPU_FAULT", "DETPU_OBS", "DETPU_TELEMETRY"):
+        env.pop(k, None)
+    env["DETPU_CKPT_RING"] = "2"
+    if fault:
+        env["DETPU_FAULT"] = fault
+    return subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=600)
+
+
+def _final_crcs(ckpt):
+    with open(os.path.join(ckpt, "meta.json"), encoding="utf-8") as f:
+        return json.load(f)["files"]
+
+
+def _parse(stdout):
+    for line in reversed(stdout.strip().splitlines()):
+        if line.startswith("FINAL"):
+            parts = line.split()
+            return dict(zip(parts[::2], parts[1::2]))
+    return None
+
+
+def main() -> int:
+    errors = []
+    with tempfile.TemporaryDirectory(prefix="detpu_online_") as tmp:
+        ckpt = os.path.join(tmp, "ck")
+        fault = f"oovflood@{FLOOD},burst@{BURST}"
+        code = _CHILD_ONLINE.format(repo=REPO, ckpt=ckpt, steps=STEPS,
+                                    slo=SLO_STEPS)
+        p = _run_child(code, fault=fault)
+        if p.returncode != 0:
+            return _fail([f"online child failed rc={p.returncode}: "
+                          f"{(p.stderr or p.stdout).strip()[-800:]}"])
+        got = _parse(p.stdout)
+        if not got or got.get("FINAL") != str(STEPS) \
+                or got.get("PREEMPTED") != "0":
+            errors.append(f"online child ended at {got} — want FINAL "
+                          f"{STEPS}, PREEMPTED 0")
+        else:
+            if int(got["ADMITTED"]) <= 0:
+                errors.append("no slot admissions under the oovflood — "
+                              "the admission gate never fired")
+            if int(got["SERVED"]) <= 0:
+                errors.append("no request was ever served")
+            if int(got["UNTYPED"]) != 0:
+                errors.append(f"{got['UNTYPED']} refusal(s) were not "
+                              "typed Overloaded — the burst leaked "
+                              "exceptions or losses")
+            if int(got["SHED"]) <= 0:
+                errors.append("the 8x burst shed nothing — the drill "
+                              "never pressured admission control")
+            if int(got["STEADY"]) != 0:
+                errors.append(
+                    f"{got['STEADY']} steady-state recompile(s): some "
+                    "mix of publication/serving/training retraced")
+            if int(got["TORN"]) != 0:
+                errors.append("served versions regressed or preceded "
+                              "the first publication — torn or stale "
+                              "snapshot reads")
+            if float(got["FRESHP95"]) > SLO_STEPS:
+                errors.append(
+                    f"freshness_p95_steps {got['FRESHP95']} exceeds the "
+                    f"SLO {SLO_STEPS} — publication fell behind")
+            if float(got["P99"]) > P99_MS:
+                errors.append(f"latency p99 {got['P99']} ms is unbounded "
+                              f"(ceiling {P99_MS})")
+            if got.get("LEVEL") != "0":
+                errors.append(f"ladder level {got['LEVEL']} at exit — "
+                              "no post-burst recovery")
+        if errors:
+            return _fail(errors)
+
+        # 2: CRC identity — the same stream without serving
+        ref = os.path.join(tmp, "ref")
+        code = _CHILD_OFFLINE.format(repo=REPO, ckpt=ref, steps=STEPS)
+        p2 = _run_child(code, fault=fault)
+        if p2.returncode != 0:
+            return _fail([f"offline reference child failed "
+                          f"rc={p2.returncode}: "
+                          f"{(p2.stderr or p2.stdout).strip()[-800:]}"])
+        crcs, ref_crcs = _final_crcs(ckpt), _final_crcs(ref)
+        if crcs != ref_crcs:
+            diff = sorted(k for k in set(crcs) | set(ref_crcs)
+                          if crcs.get(k) != ref_crcs.get(k))
+            errors.append(
+                "final checkpoints differ between the train-and-serve "
+                f"run and the train-only run (files {diff}) — concurrent "
+                "serving perturbed the training trajectory")
+        if not os.path.isfile(ckpt + ".online.json"):
+            errors.append("the online run left no version sidecar "
+                          "(<ckpt>.online.json)")
+    if errors:
+        return _fail(errors)
+    print(f"check_online: OK (oovflood@{FLOOD}+burst@{BURST}: "
+          f"{got['SERVED']} served / {got['SHED']} typed sheds, "
+          f"admissions happened, freshness p95 {got['FRESHP95']} steps "
+          f"<= SLO {SLO_STEPS}, p99 {got['P99']} ms, 0 steady-state "
+          "recompiles, versions monotone, and the training trajectory "
+          "is checkpoint-CRC-identical to the run without serving)")
+    return 0
+
+
+def _fail(errors) -> int:
+    for e in errors:
+        print(f"check_online: {e}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

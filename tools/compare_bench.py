@@ -67,6 +67,7 @@ THROUGHPUT_KEYS = (
     "telemetry_samples_per_sec",
     "streaming_samples_per_sec",
     "pipeline_samples_per_sec",
+    "online_train_samples_per_sec",
 )
 # lower is better (ms-per-iter timings and byte budgets: a >threshold
 # rise in per-step peak HBM is a regression exactly like a slower step)
@@ -573,6 +574,81 @@ def check_serving(old: Dict[str, Any], new: Dict[str, Any]) -> int:
     return failures
 
 
+#: max tolerated growth of the online section's serve p95 (same latency
+#: ratchet as the standalone serving section — fixed step-paced load)
+ONLINE_P95_TOL = 0.10
+#: max tolerated |AUC(online) - AUC(offline replay)|: the RCU snapshots
+#: are COPIES, so concurrent serving must not move the trajectory — a
+#: nonzero delta here means the publisher leaked aliased buffers or the
+#: serve path wrote into live tables (the statistical twin of
+#: check_online's checkpoint-CRC identity)
+ONLINE_MAX_AUC_DELTA = 0.002
+
+
+def check_online(old: Dict[str, Any], new: Dict[str, Any]) -> int:
+    """Gate the ``online`` section (ISSUE 16): concurrent train-and-serve
+    at fixed staleness.
+
+    * nonzero ``steady_state_recompiles`` fails outright — any mix of
+      training, publication and serving that retraces poisons both the
+      joint throughput and the latencies;
+    * ``freshness_p95_steps`` above the section's own
+      ``freshness_slo_steps`` fails — the publisher fell behind the
+      staleness budget the section claims to hold;
+    * ``auc_delta_vs_replay`` beyond :data:`ONLINE_MAX_AUC_DELTA` fails
+      — serving perturbed the training trajectory;
+    * serve ``latency_p95_ms`` growing beyond :data:`ONLINE_P95_TOL`
+      versus the baseline fails;
+    * a candidate missing the section while the baseline has it fails
+      (the online scenario crashed or was dropped — absence would hide
+      exactly what this gate watches).
+    """
+    sec = new.get("online")
+    if not isinstance(sec, dict):
+        if isinstance(old.get("online"), dict):
+            print("compare_bench: candidate has no 'online' section "
+                  "but the baseline does — the online train-and-serve "
+                  "scenario failed or was dropped", file=sys.stderr)
+            return 1
+        return 0
+    failures = 0
+    rc = sec.get("steady_state_recompiles")
+    if isinstance(rc, (int, float)) and rc > 0:
+        print(f"compare_bench: online section recompiled {int(rc)} "
+              "time(s) at steady state — training, publication or "
+              "serving retraced under the fixed joint load",
+              file=sys.stderr)
+        failures += 1
+    fresh = sec.get("freshness_p95_steps")
+    slo = sec.get("freshness_slo_steps")
+    if isinstance(fresh, (int, float)) and isinstance(slo, (int, float)) \
+            and fresh > slo:
+        print(f"compare_bench: online freshness p95 {fresh} steps "
+              f"exceeds the section's own SLO {slo} — snapshot "
+              "publication fell behind training", file=sys.stderr)
+        failures += 1
+    delta = sec.get("auc_delta_vs_replay")
+    if isinstance(delta, (int, float)) \
+            and abs(delta) > ONLINE_MAX_AUC_DELTA:
+        print(f"compare_bench: online AUC is {delta:+.4f} off the "
+              "offline replay of the identical stream (tolerance "
+              f"{ONLINE_MAX_AUC_DELTA}) — concurrent serving moved the "
+              "training trajectory", file=sys.stderr)
+        failures += 1
+    osec = old.get("online")
+    if isinstance(osec, dict):
+        op, np_ = osec.get("latency_p95_ms"), sec.get("latency_p95_ms")
+        if isinstance(op, (int, float)) and isinstance(np_, (int, float)) \
+                and op > 0 and np_ > op * (1.0 + ONLINE_P95_TOL):
+            print(f"compare_bench: online serve REGRESSION: p95 latency "
+                  f"{op:.1f} -> {np_:.1f} ms "
+                  f"(+{(np_ / op - 1) * 100:.1f}%) at fixed step-paced "
+                  "load — the snapshot-serving path got slower",
+                  file=sys.stderr)
+            failures += 1
+    return failures
+
+
 def compare(old: Dict[str, Any], new: Dict[str, Any],
             threshold: float) -> int:
     steady_failures = check_steady_state(new)
@@ -585,6 +661,7 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
                                            key="phase_profile_pipelined")
     steady_failures += check_streaming(old, new)
     steady_failures += check_serving(old, new)
+    steady_failures += check_online(old, new)
     regressions = 0
     rows = []
     for keys, higher_better in ((THROUGHPUT_KEYS, True), (MS_KEYS, False)):
