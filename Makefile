@@ -7,7 +7,8 @@ SHELL := /bin/bash
         verify lint plan-audit audit-step hlo-audit schedule-audit \
         check-backend check-obs check-obs-report check-resilience \
         check-reshard check-recovery check-streaming check-serving \
-        check-online check-phase-profile obs-report phase-profile
+        check-online check-obsplane check-phase-profile obs-report \
+        phase-profile
 
 all: native
 
@@ -32,7 +33,7 @@ bench:
 verify: lint plan-audit audit-step hlo-audit schedule-audit check-backend \
         check-obs check-obs-report check-phase-profile check-resilience \
         check-reshard check-recovery check-streaming check-serving \
-        check-online
+        check-online check-obsplane
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -154,6 +155,16 @@ check-serving:
 # the same stream without serving (parallel/online.py)
 check-online:
 	python tools/check_online.py
+
+# observability-plane drill: a world-8 child serves under burst chaos
+# while its Prometheus endpoint is scraped MID-LOAD over real HTTP; the
+# per-stage latency sketches must sum to the end-to-end served latency
+# within 5% (the p99-attribution instrument) with 0 steady-state
+# recompiles, and a second nan@-injected training child must leave a
+# CRC-intact <dir>.blackbox.json post-mortem naming the unhealthy
+# table(s) (utils/mplane.py)
+check-obsplane:
+	python tools/check_obsplane.py
 
 # optional regression gate: diff two BENCH records, nonzero exit on a >10%
 # throughput regression. Usage: make bench-diff OLD=BENCH_r04.json NEW=out.json

@@ -1545,6 +1545,141 @@ def run_online():
     }
 
 
+def run_obs_plane():
+    """Observability-plane cost section (ISSUE 17): what the metrics
+    plane itself charges, measured on a REAL world-1 serving runtime
+    whose sketches were populated by actually serving requests.
+
+    * ``stats_wall_us`` — one sketch-backed ``ServingRuntime.stats()``
+      call, the read path that replaced the O(window) raw-list
+      ``np.percentile`` sorts; this is the before/after instrument for
+      the migration and the ratchet against the plane growing a heavy
+      read path again;
+    * ``render_wall_us`` / ``scrape_ms`` — the Prometheus text render
+      of the runtime's live registry, and the full HTTP round-trip
+      against the stdlib scrape endpoint on an ephemeral port (what a
+      real scraper pays mid-load);
+    * ``dump_ms`` — one flight-recorder black-box dump with a FULL ring
+      (canonical-JSON CRC + atomic rename): the cost paid at the worst
+      possible moment (the crash path), so it must stay cheap;
+    * ``sketch_observe_ns`` — the hot-path write each ``Served`` pays
+      6x (total latency + 5 stage spans).
+
+    Costs ratchet (lower is better) via
+    ``tools/compare_bench.py::check_obs_plane``; the serving p95 itself
+    stays inside the existing ``check_serving`` gate — this section
+    prices the instrument, not the instrumented."""
+    import statistics
+    import tempfile
+    import urllib.request
+
+    from distributed_embeddings_tpu.parallel import (
+        DistributedEmbedding, ServeConfig, ServingRuntime, init_hybrid_state)
+    from distributed_embeddings_tpu.parallel import serving as sv
+    from distributed_embeddings_tpu.utils import mplane
+
+    global _STEADY_RECOMPILES
+    sizes = [2000, 500]
+    configs = [{"input_dim": v, "output_dim": 8} for v in sizes]
+    de = DistributedEmbedding(configs, world_size=1)
+    tx = optax.sgd(0.05)
+    state = init_hybrid_state(de, SparseSGD(),
+                              {"w": jnp.ones((8 * len(sizes) + 2, 1),
+                                             jnp.float32) * 0.01},
+                              tx, jax.random.key(0))
+
+    def pred_fn(dp, outs, batch):
+        x = jnp.concatenate(list(outs) + [batch], axis=-1)
+        return jax.nn.sigmoid(x @ dp["w"])[:, 0]
+
+    rt = ServingRuntime(de, pred_fn, state,
+                        config=ServeConfig(max_batch=16, max_wait_ms=0.0,
+                                           deadline_ms=60_000.0,
+                                           max_queue=4096))
+    rng = np.random.default_rng(3)
+    tmpl = sv.synthetic_request(rng, sizes, 2, numerical=2)
+    rt.warmup((tmpl.cats, tmpl.batch))
+
+    # populate the sketches with REAL served latencies (no pacing sleeps:
+    # submit small groups and flush — the sketch contents, not the QPS,
+    # are what this section prices)
+    requests = 64 if SMOKE else 512
+    served = 0
+    for i in range(requests):
+        rt.submit(sv.synthetic_request(rng, sizes,
+                                       int(rng.integers(1, 5)),
+                                       numerical=2))
+        if i % 4 == 3:
+            served += sum(isinstance(r, sv.Served) for r in rt.poll())
+    served += sum(isinstance(r, sv.Served) for r in rt.flush())
+    _STEADY_RECOMPILES += rt.stats()["steady_state_recompiles"]
+
+    def timed_us(fn, iters):
+        fn()  # warm any lazy state out of the timed region
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn()
+        return (time.perf_counter() - t0) / iters * 1e6
+
+    iters = 50 if SMOKE else 300
+    stats_us = timed_us(rt.stats, iters)
+    render_us = timed_us(rt.metrics.render, iters)
+    body = rt.metrics.render()
+
+    # the scrape a real collector pays: full HTTP round-trip against the
+    # stdlib endpoint on an ephemeral port, registry rendered per GET
+    exp = mplane.start_http_exporter(rt.metrics, port=0)
+    try:
+        def scrape():
+            with urllib.request.urlopen(exp.url(), timeout=30) as resp:
+                resp.read()
+        scrape_ms = timed_us(scrape, 10 if SMOKE else 30) / 1e3
+    finally:
+        exp.stop()
+
+    # flight-recorder dump with a FULL ring: the crash-path cost
+    sketch_src = rng.normal(loc=5.0, scale=1.0, size=4096) ** 2
+    with tempfile.TemporaryDirectory(prefix="detpu_bench_obs_") as tmp:
+        path = os.path.join(tmp, "bb.blackbox.json")
+        rec = mplane.FlightRecorder(path)
+        for i in range(rec.capacity):
+            rec.note_step(i, {f"m{k}": float(i * 31 + k)
+                              for k in range(24)})
+            rec.note_event("bench_tick", step=i)
+        for _ in range(4):
+            rec.note_stats(rt.stats())
+        durs = []
+        for _ in range(5 if SMOKE else 20):
+            t0 = time.perf_counter()
+            rec.dump("bench", reason="obs_plane_cost")
+            durs.append((time.perf_counter() - t0) * 1e3)
+        mplane.verify_blackbox(path)   # the timed dumps stayed CRC-intact
+        dump_ms = statistics.median(durs)
+        dump_bytes = os.path.getsize(path)
+
+    sk = mplane.QuantileSketch()
+    n = len(sketch_src)
+    t0 = time.perf_counter()
+    for v in sketch_src:
+        sk.observe(v)
+    observe_ns = (time.perf_counter() - t0) / n * 1e9
+
+    return {
+        "stats_wall_us": round(stats_us, 1),
+        "render_wall_us": round(render_us, 1),
+        "scrape_ms": round(scrape_ms, 3),
+        "scrape_bytes": len(body.encode("utf-8")),
+        "scrape_ok": 1,
+        "dump_ms": round(dump_ms, 3),
+        "dump_bytes": dump_bytes,
+        "sketch_observe_ns": round(observe_ns, 1),
+        "served": served,
+        "requests": requests,
+        "steady_state_recompiles": int(
+            rt.stats()["steady_state_recompiles"]),
+    }
+
+
 CONV_STEPS = 6 if SMOKE else 360
 CONV_BATCH = 512 if SMOKE else 8192
 
@@ -1908,6 +2043,13 @@ def main():
         out["online"] = online
         out["online_train_samples_per_sec"] = online[
             "train_samples_per_sec"]
+    obsplane = _guard("obs_plane", run_obs_plane)
+    if obsplane is not None:
+        # what the observability plane itself charges (sketch-backed
+        # stats(), Prometheus render + HTTP scrape, black-box dump);
+        # compare_bench's check_obs_plane ratchets the costs and fails a
+        # record whose scrape broke or whose section disappeared
+        out["obs_plane"] = obsplane
     reshard = _guard("reshard", run_reshard)
     if reshard is not None:
         out["reshard"] = reshard

@@ -80,13 +80,18 @@ import os
 import time
 from typing import Any, Callable, Dict, List, Optional
 
-from ..utils import envvars, obs
+from ..utils import envvars, mplane, obs
 from ..utils import runtime as runtime_mod
 from .resilient import ResilientResult, _atomic_json, run_resilient
 from .serving import Request, ServeResult, ServingRuntime
 from .trainer import HybridTrainState, clone_pytree
 
 logger = logging.getLogger(__name__)
+
+#: Every Nth pump rings a serving ``stats()`` snapshot into the flight
+#: recorder — cheap (sketch reads, no sorts) but not free, so not every
+#: step.
+_STATS_RING_EVERY = 10
 
 
 def online_sidecar_path(checkpoint_dir: str) -> str:
@@ -398,6 +403,11 @@ class OnlineRuntime:
         def _pump(cur, loss, metrics, state_now, telem, stream):
             now = self._clock()
             self.publisher.maybe_publish(state_now, stream, now=now)
+            rec = mplane.flight_recorder()
+            if rec is not None and cur % _STATS_RING_EVERY == 0:
+                # ring a serving-stats snapshot so a post-mortem shows
+                # the serve plane's recent history, not just training's
+                rec.note_stats(self.serving.stats())
             if warmup_template is not None and not self.serving._warm:
                 # after the train step's compile, before any traffic:
                 # the steady-state recompile baseline includes every

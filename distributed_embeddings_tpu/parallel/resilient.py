@@ -65,6 +65,7 @@ from __future__ import annotations
 
 import bisect
 import collections.abc
+import contextlib
 import dataclasses
 import json
 import logging
@@ -80,7 +81,7 @@ import numpy as np
 
 import uuid
 
-from ..utils import envvars, obs, runtime
+from ..utils import envvars, mplane, obs, runtime
 from ..utils.checkpoint import (load_aux_state, meta_run_id,
                                 previous_checkpoint_path,
                                 restore_train_state, rollback_candidates,
@@ -106,6 +107,12 @@ def quarantine_ledger_path(checkpoint_dir: str) -> str:
     """Where the rollback-and-replay recovery persists its quarantine
     ledger (beside the checkpoint directory, like the resume sentinel)."""
     return checkpoint_dir.rstrip(os.sep) + ".quarantine.json"
+
+
+def blackbox_path(checkpoint_dir: str) -> str:
+    """Where the flight recorder dumps its post-mortem (beside the
+    checkpoint directory, like the resume sentinel)."""
+    return checkpoint_dir.rstrip(os.sep) + ".blackbox.json"
 
 
 def _atomic_json(path: str, doc: Dict[str, Any]) -> None:
@@ -643,11 +650,32 @@ def run_resilient(step_fn: Callable, state, data, *,
         return (f". Quarantine ledger: {sorted(ledger.quarantined)} after "
                 f"{ledger.rollbacks} rollback(s)")
 
-    def _terminal(msg: str) -> runtime.NonFiniteLossError:
+    # the process flight recorder rides beside the checkpoint: every
+    # recovery event taps in automatically (obs.record_event), step
+    # metrics ring in at metrics_interval, and the terminal escalations
+    # below dump the black box post-mortem
+    flight = (mplane.install_flight_recorder(blackbox_path(checkpoint_dir))
+              if checkpoint_dir is not None else mplane.flight_recorder())
+    dumped_blackbox = False
+
+    def _blackbox(trigger: str, **context):
+        nonlocal dumped_blackbox
+        if flight is None:
+            return
+        context.setdefault("last_good_step", last_good)
+        context.setdefault("quarantined", sorted(ledger.quarantined))
+        context.setdefault("rollbacks", ledger.rollbacks)
+        if flight.dump(trigger, **context) is not None:
+            dumped_blackbox = True
+
+    def _terminal(msg: str,
+                  trigger: str = "nan_escalation",
+                  **context) -> runtime.NonFiniteLossError:
         # park the (guard-clean) state before dying, like the
         # pre-recovery escalation always did
         if checkpoint_dir is not None:
             _save()
+        _blackbox(trigger, message=msg, **context)
         err = runtime.NonFiniteLossError(msg + _ledger_tail())
         err.quarantined = tuple(sorted(ledger.quarantined))
         err.rollbacks = ledger.rollbacks
@@ -713,7 +741,22 @@ def run_resilient(step_fn: Callable, state, data, *,
         if metrics_logger is not None and _chief():
             metrics_logger.log_event(kind, **payload)
 
-    with _PreemptCatcher() as catcher:
+    @contextlib.contextmanager
+    def _crash_blackbox():
+        # the black box's last line of defense: ANY exception escaping
+        # the train loop that did not already dump (the typed terminals
+        # above do) leaves a post-mortem before propagating
+        try:
+            yield
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:
+            if not dumped_blackbox:
+                _blackbox("unhandled_crash", error=repr(e),
+                          error_type=type(e).__name__)
+            raise
+
+    with _crash_blackbox(), _PreemptCatcher() as catcher:
         restart = True
         while restart:
             restart = False
@@ -814,7 +857,8 @@ def run_resilient(step_fn: Callable, state, data, *,
                             f"budget (DETPU_QUARANTINE_MAX="
                             f"{quarantine_max}): the batch at stream "
                             f"position {spos} is non-finite too; last "
-                            f"good step: {last_good}")
+                            f"good step: {last_good}",
+                            trigger="quarantine_exhaustion")
                     ledger.quarantined.add(spos)
                     ledger.save(_chief())
                     # the guard held params/optimizer state bitwise;
@@ -860,7 +904,11 @@ def run_resilient(step_fn: Callable, state, data, *,
                         new_state, how = _attempt_rollback(state,
                                                            bad_window)
                         if new_state is None:
-                            raise _terminal(
+                            raise _terminal(trigger=(
+                                "rollback_exhaustion"
+                                if "budget exhausted" in how
+                                else "nan_escalation"),
+                                unhealthy_tables=unhealthy, msg=(
                                 f"non-finite loss/gradients for "
                                 f"{consecutive} consecutive steps "
                                 f"(through step {cur}); last good step: "
@@ -874,7 +922,7 @@ def run_resilient(step_fn: Callable, state, data, *,
                                    "poisoned)"
                                    if not obs.nanguard_enabled() else "")
                                 + ". Rollback-and-replay could not "
-                                  f"recover: {how}")
+                                  f"recover: {how}"))
                         ledger.rollbacks += 1
                         ledger.save(_chief())
                         replay_until = bad_window[-1]
@@ -947,6 +995,9 @@ def run_resilient(step_fn: Callable, state, data, *,
                         if metrics_logger is not None:
                             metrics_logger.log_step(host_metrics,
                                                     step=cur)
+                        if flight is not None:
+                            flight.note_step(cur,
+                                             obs.summarize(host_metrics))
 
                 if (on_step is not None and not quarantined_now
                         and on_step(cur, last_loss, metrics, state)):
@@ -983,6 +1034,8 @@ def run_resilient(step_fn: Callable, state, data, *,
                         _sentinel(True, step=int(state.step),
                                   signal=int(catcher.fired),
                                   reason="preempted")
+                    _blackbox("preemption", step=int(state.step),
+                              signal=int(catcher.fired))
                     break
 
     elapsed = time.monotonic() - t0

@@ -85,7 +85,7 @@ from typing import (Any, Callable, Dict, List, Optional, Sequence, Tuple)
 import jax
 import numpy as np
 
-from ..utils import envvars, obs
+from ..utils import envvars, mplane, obs
 from ..utils import runtime as runtime_mod
 from ..ops.embedding_lookup import Ragged
 from . import streaming as streaming_mod
@@ -96,11 +96,14 @@ logger = logging.getLogger(__name__)
 #: degradation-ladder levels (index = level)
 LEVELS = ("healthy", "pressure", "shed")
 
-#: rolling-window size of the latency / queue-depth samples behind
-#: ``stats()``'s percentiles — a long-running server must not grow host
-#: state per request (the same bounded-by-construction rule the queue
-#: obeys); percentiles describe the most recent window
-STATS_WINDOW = 16384
+#: per-request latency decomposition stages, in pipeline order: the time
+#: between submit and the reply splits EXACTLY into these five spans
+#: (queue wait is per request; the other four are per flush), each rolled
+#: into its own registry sketch so :meth:`ServingRuntime.stats` can
+#: attribute the p99 tail to a stage — the instrument behind ROADMAP
+#: item 1's "the p99 tail is exchange-bound" claim
+STAGES = ("queue_wait", "coalesce", "dispatch", "device_compute",
+          "reply_slice")
 
 
 class ServeConfig:
@@ -241,6 +244,10 @@ class Served(ServeResult):
     version: int = -1
     staleness_steps: Optional[float] = None
     staleness_s: Optional[float] = None
+    # latency decomposition: one ``<stage>_ms`` entry per :data:`STAGES`
+    # member; the five spans sum to ``latency_ms`` by construction
+    # (queue wait is this request's own, the rest are its flush's)
+    spans: Optional[Dict[str, float]] = None
 
 
 @dataclasses.dataclass
@@ -340,8 +347,32 @@ class ServingRuntime:
         self._warm = False
         self.warmup_compiles = 0
         self._compiles_at_steady = 0
-        self._lat_ms: List[float] = []
-        self._qdepth: List[int] = []
+        # ---- observability plane (utils/mplane.py): every latency /
+        # depth / freshness signal folds into a mergeable log-bucketed
+        # sketch — O(buckets) memory however long the server lives (the
+        # former raw lists grew to 2x STATS_WINDOW floats per signal and
+        # full-sorted per stats() call), quantiles within the sketch's
+        # guaranteed relative error, and per-rank sketches merge
+        # associatively for a fleet view. stats() stays a VIEW over
+        # these; the registry also renders the Prometheus scrape text
+        self.metrics = mplane.MetricsRegistry()
+        self._lat_sketch = self.metrics.sketch(
+            "detpu_serve_latency_ms",
+            "end-to-end served-request latency (ms)").child()
+        stage_fam = self.metrics.sketch(
+            "detpu_serve_stage_ms",
+            "served-request latency decomposition by stage (ms)")
+        self._stage_sketch = {s: stage_fam.child(stage=s) for s in STAGES}
+        self._qdepth_sketch = self.metrics.sketch(
+            "detpu_serve_queue_depth",
+            "queued samples observed at each admitted submit").child()
+        self._fresh_steps_sketch = self.metrics.sketch(
+            "detpu_serve_staleness_steps",
+            "per-response snapshot staleness (train steps)").child()
+        self._fresh_s_sketch = self.metrics.sketch(
+            "detpu_serve_staleness_s",
+            "per-response snapshot age (seconds)").child()
+        self.metrics.register_collector(self._collect)
         self._pad_slots = 0
         self._total_slots = 0
         self._rung_flushes: Dict[int, int] = {r: 0 for r in self.rungs}
@@ -358,8 +389,30 @@ class ServingRuntime:
         self._freshness_max_steps = envvars.get_int(
             "DETPU_FRESHNESS_MAX_STEPS")
         self._freshness_max_s = envvars.get_float("DETPU_FRESHNESS_MAX_S")
-        self._fresh_steps: List[float] = []
-        self._fresh_s: List[float] = []
+
+    def _collect(self) -> None:
+        """Scrape-time adapter: mirror the host counts and point-in-time
+        gauges into the runtime's registry. The sketches observe inline
+        on the hot path; everything countable syncs lazily, exactly when
+        someone renders — scraping is the only cost of being scrapable."""
+        mplane.sync_counters(self.metrics, self._counts,
+                             name="detpu_serve_total", label="outcome")
+        mplane.sync_counters(self.metrics, obs.counters())
+        g = self.metrics.gauge
+        g("detpu_serve_level",
+          "degradation-ladder level (0 healthy, 1 pressure, 2 shed)"
+          ).set(self._level)
+        g("detpu_serve_queued_samples",
+          "samples queued right now").set(self._queued_samples)
+        g("detpu_serve_pad_fraction",
+          "aggregate padded-slot fraction across flushes").set(
+            self._pad_slots / self._total_slots if self._total_slots
+            else 0.0)
+        g("detpu_serve_steady_state_recompiles",
+          "compiles since warmup (the 0-recompile contract)").set(
+            self.steady_recompiles())
+        g("detpu_serve_freshness_stale",
+          "1 while the freshness SLO is breached").set(int(self._stale))
 
     # --------------------------------------------- published table views
 
@@ -474,6 +527,14 @@ class ServingRuntime:
                 "serving snapshot v%d is STALE (%d step(s) / %.3f s "
                 "behind training) — entering the shed rung", version,
                 lag_steps, age_s)
+            rec = mplane.flight_recorder()
+            if rec is not None:
+                # freshness/SLO breach: park a post-mortem while the
+                # breach is live (the black box names the lagging
+                # version and carries the recent stats ring)
+                rec.note_stats(self.stats())
+                rec.dump("freshness_breach", version=int(version),
+                         lag_steps=int(lag_steps), age_s=float(age_s))
         self._stale = stale
         self._update_level()
 
@@ -599,9 +660,7 @@ class ServingRuntime:
                               level=self._level, queue_samples=q)
         self._queue.append(req)
         self._queued_samples += req.n
-        self._qdepth.append(self._queued_samples)
-        if len(self._qdepth) > 2 * STATS_WINDOW:
-            del self._qdepth[:-STATS_WINDOW]
+        self._qdepth_sketch.observe(self._queued_samples)
         self._update_level()
         return None
 
@@ -790,17 +849,28 @@ class ServingRuntime:
         # however the publisher interleaves (the no-torn-read contract)
         published = self._published
         cats, batch, offsets = self._pack(reqs, rung)
-        preds = np.asarray(self._dispatch(cats, batch, published))
+        t_pack = self._clock()
+        pending = self._dispatch(cats, batch, published)
+        t_disp = self._clock()
+        preds = np.asarray(pending)  # device compute + host fetch
+        t_dev = self._clock()
+        slices = [preds[o:o + r.n] for r, o in zip(reqs, offsets)]
         t1 = self._clock()
-        self._est_s = (t1 - t0 if not self._est_s
-                       else 0.7 * self._est_s + 0.3 * (t1 - t0))
+        self._est_s = (t_dev - t0 if not self._est_s
+                       else 0.7 * self._est_s + 0.3 * (t_dev - t0))
         n = sum(r.n for r in reqs)
         self._pad_slots += rung - n
         self._total_slots += rung
         self._counts["flushes"] += 1
         self._rung_flushes[rung] = self._rung_flushes.get(rung, 0) + 1
-        if len(self._lat_ms) > 2 * STATS_WINDOW:
-            del self._lat_ms[:-STATS_WINDOW]
+        # latency decomposition: the flush-level spans are shared by
+        # every coalesced request (they waited on the SAME pack /
+        # dispatch / device / slice work); queue wait is per request.
+        # The five spans sum to each request's latency by construction
+        coalesce_ms = (t_pack - t0) * 1e3
+        dispatch_ms = (t_disp - t_pack) * 1e3
+        device_ms = (t_dev - t_disp) * 1e3
+        reply_ms = (t1 - t_dev) * 1e3
         # per-response freshness: how stale the answering snapshot was at
         # flush time, in steps (vs the trainer's newest completed step)
         # and seconds (snapshot age) — the freshness SLO's raw samples
@@ -813,15 +883,23 @@ class ServingRuntime:
             latest = (self._latest_train_step if self._latest_train_step
                       is not None else snap_step)
             stale_steps = float(max(0, latest - snap_step))
-            stale_s = float(max(0.0, t1 - pub_t))
+            stale_s = float(max(0.0, t_dev - pub_t))
         out = []
-        for r, o in zip(reqs, offsets):
+        for r, pred in zip(reqs, slices):
             lat = (t1 - r.t_submit) * 1e3
+            queue_wait_ms = (t0 - r.t_submit) * 1e3
             missed = t1 > r.deadline
-            self._lat_ms.append(lat)
+            spans = {"queue_wait_ms": queue_wait_ms,
+                     "coalesce_ms": coalesce_ms,
+                     "dispatch_ms": dispatch_ms,
+                     "device_compute_ms": device_ms,
+                     "reply_slice_ms": reply_ms}
+            self._lat_sketch.observe(lat)
+            for stage, v in zip(STAGES, spans.values()):
+                self._stage_sketch[stage].observe(max(0.0, v))
             if meta is not None:
-                self._fresh_steps.append(stale_steps)
-                self._fresh_s.append(stale_s)
+                self._fresh_steps_sketch.observe(stale_steps)
+                self._fresh_s_sketch.observe(stale_s)
             self._counts["served"] += 1
             self._counts["served_samples"] += r.n
             if missed:
@@ -829,13 +907,10 @@ class ServingRuntime:
                 obs.counter_inc("serve_deadline_missed")
             obs.counter_inc("serve_served")
             out.append(Served(rid=r.rid, latency_ms=lat,
-                              predictions=preds[o:o + r.n], rung=rung,
+                              predictions=pred, rung=rung,
                               deadline_missed=missed, version=version,
                               staleness_steps=stale_steps,
-                              staleness_s=stale_s))
-        if len(self._fresh_steps) > 2 * STATS_WINDOW:
-            del self._fresh_steps[:-STATS_WINDOW]
-            del self._fresh_s[:-STATS_WINDOW]
+                              staleness_s=stale_s, spans=spans))
         return out
 
     def poll(self, now: Optional[float] = None) -> List[ServeResult]:
@@ -934,13 +1009,26 @@ class ServingRuntime:
         """Host summary: counts, latency percentiles over served
         requests, aggregate pad fraction, queue-depth p95, recompile
         verdicts — the dict the bench section and the check drill
-        read."""
-        lat = np.asarray(self._lat_ms, np.float64)
-        q = np.asarray(self._qdepth, np.float64)
-        pct = (lambda p: float(np.percentile(lat, p))) if lat.size \
-            else (lambda p: None)
-        fsteps = np.asarray(self._fresh_steps, np.float64)
-        fs = np.asarray(self._fresh_s, np.float64)
+        read. Percentiles come from the registry's mergeable
+        log-bucketed sketches (bounded memory, no full sort); every
+        key that predates the sketch migration is preserved as a view,
+        plus ``latency_stages_ms`` / ``p99_dominant_stage`` — the
+        p99-attribution instrument."""
+        lat = self._lat_sketch
+        pct = ((lambda p: lat.quantile(p / 100.0)) if lat.count
+               else (lambda p: None))
+        stages: Dict[str, Dict[str, float]] = {}
+        for stage in STAGES:
+            sk = self._stage_sketch[stage]
+            if not sk.count:
+                continue
+            stages[stage] = {
+                "p50": sk.quantile(0.50), "p95": sk.quantile(0.95),
+                "p99": sk.quantile(0.99), "mean": sk.mean,
+                "sum": sk.sum, "count": sk.count,
+            }
+        dominant = (max(stages, key=lambda s: stages[s]["p99"])
+                    if stages else None)
         meta = self._published[2]
         return {
             **self._counts,
@@ -950,10 +1038,12 @@ class ServingRuntime:
             "latency_p50_ms": pct(50),
             "latency_p95_ms": pct(95),
             "latency_p99_ms": pct(99),
+            "latency_stages_ms": stages,
+            "p99_dominant_stage": dominant,
             "pad_fraction": (self._pad_slots / self._total_slots
                              if self._total_slots else 0.0),
-            "queue_depth_p95": (float(np.percentile(q, 95))
-                                if q.size else 0.0),
+            "queue_depth_p95": (self._qdepth_sketch.quantile(0.95)
+                                if self._qdepth_sketch.count else 0.0),
             "rung_flushes": {str(k): v
                              for k, v in sorted(self._rung_flushes.items())
                              if v},
@@ -963,10 +1053,11 @@ class ServingRuntime:
             "shed_frac_of_submitted": (self._counts["shed"] / self._next_rid
                                        if self._next_rid else 0.0),
             # freshness SLO, next to p99 (None until a snapshot serves)
-            "freshness_p95_steps": (float(np.percentile(fsteps, 95))
-                                    if fsteps.size else None),
-            "freshness_p95_s": (float(np.percentile(fs, 95))
-                                if fs.size else None),
+            "freshness_p95_steps": (self._fresh_steps_sketch.quantile(0.95)
+                                    if self._fresh_steps_sketch.count
+                                    else None),
+            "freshness_p95_s": (self._fresh_s_sketch.quantile(0.95)
+                                if self._fresh_s_sketch.count else None),
             "snapshot_version": meta[0] if meta is not None else None,
             "snapshot_train_step": meta[1] if meta is not None else None,
             "freshness_stale": bool(self._stale),

@@ -101,14 +101,34 @@ def get_int(name: str, default: Optional[int] = None) -> int:
 # reads these calls from the AST). Keep alphabetical within each block.
 # --------------------------------------------------------------------------
 
-# observability (utils/obs.py)
+# observability (utils/obs.py + utils/mplane.py)
+declare("DETPU_BLACKBOX", default="1",
+        doc="0 = disable the flight recorder (utils/mplane.py): no "
+            "black-box ring is installed and no <dir>.blackbox.json "
+            "post-mortem is dumped on NaN escalation / rollback "
+            "exhaustion / freshness breach / preemption / crash")
+declare("DETPU_BLACKBOX_RING", default="64",
+        doc="flight-recorder ring capacity: how many recent step-metric "
+            "summaries, events, and stats snapshots (each kind "
+            "separately) the black-box dump carries")
+declare("DETPU_METRICS_PORT", default=None,
+        doc="opt-in Prometheus scrape endpoint port (utils/mplane.py "
+            "start_http_exporter serves GET /metrics as text "
+            "exposition); unset = no endpoint, 0 = ephemeral port "
+            "(tests/drills read it back from the exporter handle)")
 declare("DETPU_OBS", default="",
         doc="1 = build train steps with on-device step metrics (3-tuple "
             "return) and emit metrics sidecars")
 declare("DETPU_OBS_MAX_BYTES", default="0",
         doc="MetricsLogger sidecar size cap in bytes; on overflow the "
-            "file rotates to <path>.1 (one generation kept). 0 = "
-            "unbounded (the historical behavior)")
+            "file rotates through <path>.1..<path>.N "
+            "(DETPU_OBS_MAX_FILES generations kept). 0 = unbounded "
+            "(the historical behavior)")
+declare("DETPU_OBS_MAX_FILES", default="2",
+        doc="rotated MetricsLogger generations kept beyond the live "
+            "sidecar (<path>.1 newest .. <path>.N oldest — the "
+            "checkpoint-ring idiom); total disk is bounded by "
+            "(N + 1) * DETPU_OBS_MAX_BYTES")
 declare("DETPU_OBS_SIDECAR", default="BENCH.metrics.jsonl",
         doc="path of the step-metrics JSONL sidecar bench.py writes under "
             "DETPU_OBS=1")

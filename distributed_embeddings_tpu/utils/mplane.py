@@ -1,0 +1,734 @@
+"""Production observability plane: one metrics registry, mergeable
+quantile sketches, a Prometheus scrape surface, and a crash flight
+recorder.
+
+The runtimes grew four ad-hoc signal surfaces — :class:`~.obs.
+MetricsLogger` JSONL, :func:`~.obs.record_event`, the serving runtime's
+raw latency lists, and the process counters — with no single scrapeable
+plane, no bounded-memory percentiles, and no post-mortem artifact when a
+run dies. This module is that plane. Four pieces, zero dependencies
+(stdlib only — like the rest of :mod:`..utils`'s host layer it never
+imports jax OR numpy, so it works in processes that never load a
+backend):
+
+* **:class:`QuantileSketch`** — a DDSketch-style log-bucketed quantile
+  sketch: values land in geometrically-spaced buckets (ratio
+  ``gamma = (1+a)/(1-a)`` for relative accuracy ``a``), so any quantile
+  reads back within a GUARANTEED relative error ``a`` of the true value,
+  memory is O(buckets) however many samples arrive (the serving runtime
+  previously kept O(STATS_WINDOW) raw floats per signal and full-sorted
+  them per ``stats()`` call), and two sketches MERGE associatively and
+  commutatively by bucket-count addition — per-rank/per-process sketches
+  fold into one fleet view losslessly.
+* **:class:`MetricsRegistry`** — labeled counter / gauge / sketch
+  families, one namespace. Families render to the Prometheus text
+  exposition format (counters/gauges as-is, sketches as ``summary``
+  quantiles); collector callbacks registered with
+  :meth:`MetricsRegistry.register_collector` refresh adapter-fed values
+  at scrape time (the idiomatic pull model), which is how the existing
+  surfaces — process counters, serving stats, step metrics — feed the
+  plane without any caller changing.
+* **The scrape endpoint** — :func:`start_http_exporter` serves
+  ``GET /metrics`` from a stdlib ``ThreadingHTTPServer`` on an opt-in
+  port (``DETPU_METRICS_PORT``; 0 picks an ephemeral port for tests),
+  and :meth:`MetricsRegistry.export_file` atomically writes the same
+  text for air-gapped runs (tmp + fsync + rename — the ``_atomic_json``
+  idiom).
+* **:class:`FlightRecorder`** — a bounded ring of recent step metrics,
+  events, and stats snapshots, dumped ATOMICALLY (with a CRC32 stamp of
+  the payload) to ``<checkpoint_dir>.blackbox.json`` on NaN escalation,
+  rollback exhaustion, freshness/SLO breach, preemption, and unhandled
+  crash. The ring is tiny and always on once installed; the dump is the
+  only I/O and happens exactly when the run is already dying (or
+  breaching) — a black box, not a logger.
+
+``parallel/serving.py`` owns a registry per runtime (its ``stats()``
+dict stays a VIEW over the sketches — no caller breaks),
+``parallel/resilient.py`` installs the process flight recorder beside
+its checkpoint directory, and ``tools/check_obsplane.py`` (= ``make
+check-obsplane``) drills the whole plane end to end: scrape under
+burst chaos, per-stage p99 decomposition summing to the end-to-end
+latency, and a CRC-intact black box after an injected NaN escalation.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import threading
+import time
+import zlib
+from typing import (Any, Callable, Dict, Iterable, List, Optional, Tuple)
+
+from . import envvars
+
+logger = logging.getLogger(__name__)
+
+METRICS_PORT_ENV = "DETPU_METRICS_PORT"
+BLACKBOX_ENV = "DETPU_BLACKBOX"
+BLACKBOX_RING_ENV = "DETPU_BLACKBOX_RING"
+
+#: Default guaranteed relative accuracy of registry sketches: a reported
+#: quantile ``q`` satisfies ``|q - true| <= 0.01 * true`` — more than
+#: enough to gate a p99 against an SLO, at ~1.4k buckets per *decade
+#: span* of the data (sparse dict: only touched buckets exist).
+DEFAULT_RELATIVE_ACCURACY = 0.01
+
+#: Values at or below this observe into the dedicated zero bucket (the
+#: log mapping needs a positive floor); latencies in ms sit far above.
+MIN_TRACKABLE = 1e-9
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, str]) -> _LabelKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _render_labels(key: _LabelKey, extra: Tuple[Tuple[str, str], ...] = ()
+                   ) -> str:
+    items = key + extra
+    if not items:
+        return ""
+    body = ",".join(
+        '{}="{}"'.format(k, v.replace("\\", r"\\").replace('"', r'\"'))
+        for k, v in items)
+    return "{" + body + "}"
+
+
+def _fmt(v: float) -> str:
+    """Prometheus number formatting: integers render bare, floats
+    ``repr``-style (full precision, parseable back)."""
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:  # capacity-ok: float-precision
+        # bound for bare-integer rendering, not a byte limit
+        return str(int(f))
+    return repr(f)
+
+
+# ------------------------------------------------------------ the sketch
+
+
+class QuantileSketch:
+    """Mergeable log-bucketed quantile sketch (DDSketch-style).
+
+    A positive value ``x`` lands in bucket ``ceil(log_gamma(x))`` where
+    ``gamma = (1 + a) / (1 - a)``; reporting the bucket's log-midpoint
+    ``2 * gamma^i / (gamma + 1)`` guarantees relative error ``<= a`` for
+    every quantile. Buckets are a sparse dict (only touched indices
+    exist), so memory is O(distinct buckets) — bounded by
+    ``max_buckets`` via DDSketch's lowest-bucket collapse, which
+    preserves the accuracy of every quantile above the collapsed floor
+    (the high quantiles a latency SLO reads).
+
+    :meth:`merge` adds bucket counts — associative and commutative by
+    construction, so per-rank / per-process sketches fold into one
+    fleet-wide view in any order with no accuracy loss.
+    """
+
+    __slots__ = ("relative_accuracy", "_gamma", "_log_gamma", "buckets",
+                 "zero_count", "count", "sum", "min", "max", "max_buckets")
+
+    def __init__(self, relative_accuracy: float = DEFAULT_RELATIVE_ACCURACY,
+                 max_buckets: int = 4096):
+        if not (0.0 < relative_accuracy < 1.0):
+            raise ValueError("relative_accuracy must be in (0, 1)")
+        self.relative_accuracy = float(relative_accuracy)
+        self._gamma = (1.0 + relative_accuracy) / (1.0 - relative_accuracy)
+        self._log_gamma = math.log(self._gamma)
+        self.max_buckets = int(max_buckets)
+        self.buckets: Dict[int, int] = {}
+        self.zero_count = 0
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def observe(self, value: float) -> None:
+        """Fold one sample in (O(1); negative values clamp to the zero
+        bucket — every signal here is a latency/depth/age, never below
+        zero by construction)."""
+        v = float(value)
+        self.count += 1
+        self.sum += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+        if v <= MIN_TRACKABLE:
+            self.zero_count += 1
+            return
+        i = math.ceil(math.log(v) / self._log_gamma)
+        self.buckets[i] = self.buckets.get(i, 0) + 1
+        if len(self.buckets) > self.max_buckets:
+            self._collapse()
+
+    def _collapse(self) -> None:
+        # DDSketch collapse: fold the LOWEST buckets together so the
+        # high quantiles (the ones SLOs read) keep their guarantee
+        idx = sorted(self.buckets)
+        floor = idx[len(idx) - self.max_buckets]
+        folded = 0
+        for i in idx:
+            if i >= floor:
+                break
+            folded += self.buckets.pop(i)
+        self.buckets[floor] = self.buckets.get(floor, 0) + folded
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Value at quantile ``q`` in [0, 1] (within the relative-error
+        guarantee), ``None`` when empty."""
+        if self.count == 0:
+            return None
+        if not (0.0 <= q <= 1.0):
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        rank = q * (self.count - 1)
+        seen = self.zero_count
+        if rank < seen:
+            return 0.0
+        for i in sorted(self.buckets):
+            seen += self.buckets[i]
+            if rank < seen:
+                # bucket (gamma^(i-1), gamma^i]: the log-midpoint keeps
+                # |reported - true| <= a * true for anything inside
+                mid = 2.0 * self._gamma ** i / (self._gamma + 1.0)
+                return min(mid, self.max)
+        return self.max if self.max > -math.inf else None
+
+    @property
+    def mean(self) -> Optional[float]:
+        return self.sum / self.count if self.count else None
+
+    def merge(self, other: "QuantileSketch") -> "QuantileSketch":
+        """Fold ``other`` into self (in place); bucket-count addition,
+        so merge order never matters. Accuracies must match — merging
+        differently-spaced buckets would silently void the guarantee."""
+        if other.relative_accuracy != self.relative_accuracy:
+            raise ValueError(
+                f"cannot merge sketches of different accuracy "
+                f"({self.relative_accuracy} vs {other.relative_accuracy})")
+        for i, n in other.buckets.items():
+            self.buckets[i] = self.buckets.get(i, 0) + n
+        self.zero_count += other.zero_count
+        self.count += other.count
+        self.sum += other.sum
+        self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
+        if len(self.buckets) > self.max_buckets:
+            self._collapse()
+        return self
+
+    def to_dict(self) -> Dict[str, Any]:
+        """JSON-portable form (cross-process merge / file export)."""
+        return {"relative_accuracy": self.relative_accuracy,
+                "buckets": {str(i): n for i, n in self.buckets.items()},
+                "zero_count": self.zero_count, "count": self.count,
+                "sum": self.sum,
+                "min": self.min if self.count else None,
+                "max": self.max if self.count else None}
+
+    @classmethod
+    def from_dict(cls, doc: Dict[str, Any]) -> "QuantileSketch":
+        sk = cls(relative_accuracy=float(doc["relative_accuracy"]))
+        sk.buckets = {int(i): int(n)
+                      for i, n in dict(doc.get("buckets", {})).items()}
+        sk.zero_count = int(doc.get("zero_count", 0))
+        sk.count = int(doc.get("count", 0))
+        sk.sum = float(doc.get("sum", 0.0))
+        sk.min = doc["min"] if doc.get("min") is not None else math.inf
+        sk.max = doc["max"] if doc.get("max") is not None else -math.inf
+        return sk
+
+
+# ---------------------------------------------------------- the registry
+
+
+class _Family:
+    """One named metric family: children keyed by their label set."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str):
+        self.name = name
+        self.help = help_text
+        self._children: Dict[_LabelKey, Any] = {}
+
+    def child(self, **labels: str):
+        key = _label_key(labels)
+        c = self._children.get(key)
+        if c is None:
+            c = self._new_child()
+            self._children[key] = c
+        return c
+
+    def _new_child(self):
+        raise NotImplementedError
+
+    def items(self) -> Iterable[Tuple[_LabelKey, Any]]:
+        return sorted(self._children.items())
+
+
+class _Value:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+
+class CounterFamily(_Family):
+    """Monotone counts. ``inc`` bumps; ``set_total`` is the adapter
+    entry point for mirroring an externally-owned monotone total (the
+    process counters) without double counting."""
+
+    kind = "counter"
+
+    def _new_child(self) -> _Value:
+        return _Value()
+
+    def inc(self, n: float = 1, **labels: str) -> None:
+        self.child(**labels).value += n
+
+    def set_total(self, total: float, **labels: str) -> None:
+        self.child(**labels).value = float(total)
+
+
+class GaugeFamily(_Family):
+    """Point-in-time values (queue depth, level, pad fraction)."""
+
+    kind = "gauge"
+
+    def _new_child(self) -> _Value:
+        return _Value()
+
+    def set(self, v: float, **labels: str) -> None:
+        self.child(**labels).value = float(v)
+
+
+class SketchFamily(_Family):
+    """Labeled :class:`QuantileSketch` children; renders as a
+    Prometheus ``summary`` (quantile series + ``_sum`` + ``_count``)."""
+
+    kind = "summary"
+
+    #: quantiles each sketch exposes on the scrape surface
+    QUANTILES = (0.5, 0.9, 0.95, 0.99)
+
+    def __init__(self, name: str, help_text: str,
+                 relative_accuracy: float = DEFAULT_RELATIVE_ACCURACY):
+        super().__init__(name, help_text)
+        self.relative_accuracy = float(relative_accuracy)
+
+    def _new_child(self) -> QuantileSketch:
+        return QuantileSketch(relative_accuracy=self.relative_accuracy)
+
+    def observe(self, v: float, **labels: str) -> None:
+        self.child(**labels).observe(v)
+
+
+class MetricsRegistry:
+    """One namespace of labeled metric families + the render/export
+    surface. Thread-safe for the scrape path (the HTTP exporter renders
+    from its own thread while the runtime observes)."""
+
+    def __init__(self):
+        self._families: Dict[str, _Family] = {}
+        self._collectors: List[Callable[[], None]] = []
+        self._lock = threading.Lock()
+
+    def _family(self, name: str, factory: Callable[[], _Family]) -> _Family:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = factory()
+                self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help_text: str = "") -> CounterFamily:
+        fam = self._family(name, lambda: CounterFamily(name, help_text))
+        if not isinstance(fam, CounterFamily):
+            raise TypeError(f"{name} is registered as a {fam.kind}")
+        return fam
+
+    def gauge(self, name: str, help_text: str = "") -> GaugeFamily:
+        fam = self._family(name, lambda: GaugeFamily(name, help_text))
+        if not isinstance(fam, GaugeFamily):
+            raise TypeError(f"{name} is registered as a {fam.kind}")
+        return fam
+
+    def sketch(self, name: str, help_text: str = "",
+               relative_accuracy: float = DEFAULT_RELATIVE_ACCURACY
+               ) -> SketchFamily:
+        fam = self._family(
+            name, lambda: SketchFamily(name, help_text, relative_accuracy))
+        if not isinstance(fam, SketchFamily):
+            raise TypeError(f"{name} is registered as a {fam.kind}")
+        return fam
+
+    def register_collector(self, fn: Callable[[], None]) -> None:
+        """Register a callback run at the START of every render — the
+        pull-model adapter hook: a runtime syncs its counts/gauges into
+        the registry exactly when someone scrapes."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    def render(self) -> str:
+        """The Prometheus text exposition of every family."""
+        for fn in list(self._collectors):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 - a broken adapter must not
+                # take the scrape surface (and every OTHER signal) down
+                logger.exception("mplane: collector failed; skipping")
+        lines: List[str] = []
+        with self._lock:
+            fams = sorted(self._families.items())
+        for name, fam in fams:
+            if fam.help:
+                lines.append(f"# HELP {name} {fam.help}")
+            lines.append(f"# TYPE {name} {fam.kind}")
+            if isinstance(fam, SketchFamily):
+                for key, sk in fam.items():
+                    for q in fam.QUANTILES:
+                        v = sk.quantile(q)
+                        if v is None:
+                            continue
+                        lines.append(
+                            f"{name}"
+                            f"{_render_labels(key, (('quantile', str(q)),))}"
+                            f" {_fmt(v)}")
+                    lines.append(
+                        f"{name}_sum{_render_labels(key)} {_fmt(sk.sum)}")
+                    lines.append(
+                        f"{name}_count{_render_labels(key)} {sk.count}")
+            else:
+                for key, child in fam.items():
+                    lines.append(
+                        f"{name}{_render_labels(key)} {_fmt(child.value)}")
+        return "\n".join(lines) + "\n"
+
+    def export_file(self, path: str) -> str:
+        """Atomic file export of :meth:`render` (tmp + fsync + rename)
+        for air-gapped runs with no scrape port; returns ``path``."""
+        _atomic_write(path, self.render())
+        return path
+
+    def to_dict(self) -> Dict[str, Any]:
+        """JSON-portable snapshot (sketches in mergeable form) —
+        cross-process aggregation reads this, merges sketches with
+        :meth:`QuantileSketch.merge`, and re-renders."""
+        for fn in list(self._collectors):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 - same policy as render()
+                logger.exception("mplane: collector failed; skipping")
+        out: Dict[str, Any] = {}
+        with self._lock:
+            fams = sorted(self._families.items())
+        for name, fam in fams:
+            entries = []
+            for key, child in fam.items():
+                val = (child.to_dict() if isinstance(child, QuantileSketch)
+                       else child.value)
+                entries.append({"labels": dict(key), "value": val})
+            out[name] = {"kind": fam.kind, "help": fam.help,
+                         "series": entries}
+        return out
+
+
+_default_registry: Optional[MetricsRegistry] = None
+_default_lock = threading.Lock()
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-wide registry (created on first use). Runtimes that
+    want isolation (tests, multiple servers) own their own
+    :class:`MetricsRegistry` and pass it to the exporter explicitly."""
+    global _default_registry
+    with _default_lock:
+        if _default_registry is None:
+            _default_registry = MetricsRegistry()
+        return _default_registry
+
+
+def sync_counters(registry: MetricsRegistry,
+                  counts: Dict[str, Any],
+                  name: str = "detpu_events_total",
+                  label: str = "event") -> None:
+    """Adapter: mirror a monotone ``{name: count}`` dict (the
+    :func:`~.obs.counters` snapshot, a serving runtime's ``_counts``)
+    into one labeled counter family."""
+    fam = registry.counter(
+        name, "process event totals (mirrored monotone counts)")
+    for k, v in counts.items():
+        try:
+            fam.set_total(float(v), **{label: str(k)})
+        except (TypeError, ValueError):
+            continue
+
+
+def sync_step_metrics(registry: MetricsRegistry,
+                      summary: Dict[str, Any],
+                      prefix: str = "detpu_step_") -> None:
+    """Adapter: mirror one :func:`~.obs.summarize`'d step-metrics dict
+    into gauges (last-step view — trend history belongs to the JSONL
+    sidecar, the scrape plane carries the NOW)."""
+    for k, v in summary.items():
+        try:
+            registry.gauge(prefix + k, f"step metric {k} (last logged "
+                           "step)").set(float(v))
+        except (TypeError, ValueError):
+            continue
+
+
+# ----------------------------------------------------- the scrape server
+
+
+class _Exporter:
+    """Handle on a running scrape endpoint (daemon thread)."""
+
+    def __init__(self, server, thread):
+        self._server = server
+        self._thread = thread
+        self.port = int(server.server_address[1])
+
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}/metrics"
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+
+
+def start_http_exporter(registry: Optional[MetricsRegistry] = None,
+                        port: Optional[int] = None
+                        ) -> Optional[_Exporter]:
+    """Serve ``GET /metrics`` (Prometheus text) from a stdlib HTTP
+    server on a daemon thread.
+
+    ``port=None`` reads ``DETPU_METRICS_PORT``; unset/empty means the
+    endpoint is OFF and the call is a no-op returning ``None`` (the
+    default: serving a port is opt-in). ``port=0`` binds an ephemeral
+    port (tests / one-shot drills read it back from the returned
+    handle's ``.port``)."""
+    if port is None:
+        raw = envvars.get(METRICS_PORT_ENV)
+        if raw in (None, ""):
+            return None
+        try:
+            port = int(raw)
+        except ValueError:
+            logger.warning("mplane: DETPU_METRICS_PORT=%r is not a port; "
+                           "scrape endpoint disabled", raw)
+            return None
+    reg = registry if registry is not None else default_registry()
+
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - http.server API
+            if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+                self.send_error(404)
+                return
+            body = reg.render().encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):  # noqa: A003 - silence stderr
+            del fmt, args
+
+    server = ThreadingHTTPServer(("127.0.0.1", int(port)), Handler)
+    server.daemon_threads = True
+    thread = threading.Thread(target=server.serve_forever,
+                              name="detpu-metrics-exporter", daemon=True)
+    thread.start()
+    exp = _Exporter(server, thread)
+    logger.info("mplane: metrics scrape endpoint on %s", exp.url())
+    return exp
+
+
+# -------------------------------------------------- the flight recorder
+
+
+def _atomic_write(path: str, text: str) -> None:
+    """Atomic text write (tmp + flush + fsync + rename) — the same
+    durability idiom as ``parallel/resilient.py``'s ``_atomic_json``
+    (duplicated here because utils must never import parallel)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class FlightRecorder:
+    """Bounded ring of recent step metrics, events, and stats
+    snapshots; :meth:`dump` writes the whole ring atomically as the
+    post-mortem black box.
+
+    The ring holds the last ``capacity`` records PER KIND
+    (``DETPU_BLACKBOX_RING``, default 64) — appending is a deque push,
+    never I/O. :meth:`dump` serializes everything plus the triggering
+    event and a CRC32 of the canonical payload into
+    ``<checkpoint_dir>.blackbox.json`` via tmp+fsync+rename, so a crash
+    mid-dump leaves either the previous black box or the new one,
+    never a torn file. ``verify_blackbox`` checks the CRC back.
+    """
+
+    def __init__(self, path: str, capacity: Optional[int] = None):
+        self.path = path
+        self.capacity = (envvars.get_int(BLACKBOX_RING_ENV)
+                         if capacity is None else int(capacity))
+        self.capacity = max(1, self.capacity)
+        self._steps: List[Dict[str, Any]] = []
+        self._events: List[Dict[str, Any]] = []
+        self._stats: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self.dumps = 0
+
+    def _push(self, ring: List[Dict[str, Any]], rec: Dict[str, Any]) -> None:
+        with self._lock:
+            ring.append(rec)
+            if len(ring) > self.capacity:
+                del ring[:len(ring) - self.capacity]
+
+    def note_step(self, step: int, metrics: Dict[str, Any]) -> None:
+        """Ring in one (host-scalar) step-metrics summary."""
+        self._push(self._steps, {"step": int(step), "time": time.time(),
+                                 "metrics": _jsonable(metrics)})
+
+    def note_event(self, kind: str, **payload: Any) -> None:
+        """Ring in one structured event (the :func:`~.obs.record_event`
+        tap feeds every process event here automatically)."""
+        self._push(self._events, {"event": kind, "time": time.time(),
+                                  **_jsonable(payload)})
+
+    def note_stats(self, stats: Dict[str, Any],
+                   source: str = "serving") -> None:
+        """Ring in one runtime ``stats()`` snapshot."""
+        self._push(self._stats, {"source": source, "time": time.time(),
+                                 "stats": _jsonable(stats)})
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"steps": list(self._steps),
+                    "events": list(self._events),
+                    "stats": list(self._stats)}
+
+    def dump(self, trigger: str, **context: Any) -> Optional[str]:
+        """Write the black box. Returns the path, or ``None`` when the
+        write failed — a post-mortem must never raise over the original
+        failure it is documenting."""
+        payload = dict(self.snapshot(), trigger=str(trigger),
+                       context=_jsonable(context), time=time.time(),
+                       capacity=self.capacity)
+        try:
+            from . import obs
+            payload["counters"] = obs.counters()
+        except Exception:  # noqa: BLE001 - counters are best-effort here
+            payload["counters"] = {}
+        body = json.dumps(payload, sort_keys=True)
+        doc = {"crc32": zlib.crc32(body.encode("utf-8")) & 0xFFFFFFFF,
+               "payload": payload}
+        try:
+            _atomic_write(self.path, json.dumps(doc, sort_keys=True))
+        except OSError:
+            logger.exception("mplane: flight-recorder dump to %s failed",
+                             self.path)
+            return None
+        self.dumps += 1
+        logger.warning("mplane: flight recorder dumped black box to %s "
+                       "(trigger=%s)", self.path, trigger)
+        return self.path
+
+
+def verify_blackbox(path: str) -> Dict[str, Any]:
+    """Load a black box and verify its CRC32 stamp; raises ``ValueError``
+    on mismatch (a torn/corrupted post-mortem must not be trusted
+    silently). Returns the payload."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    body = json.dumps(doc["payload"], sort_keys=True)
+    crc = zlib.crc32(body.encode("utf-8")) & 0xFFFFFFFF
+    if crc != int(doc["crc32"]):
+        raise ValueError(f"black box {path} CRC mismatch "
+                         f"(recorded {doc['crc32']}, computed {crc})")
+    return doc["payload"]
+
+
+def _jsonable(obj: Any) -> Any:
+    """Best-effort JSON coercion: numpy/device scalars via item/tolist,
+    unknown objects via repr — a black box must accept whatever payload
+    the dying run hands it."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if hasattr(obj, "tolist"):
+        try:
+            return _jsonable(obj.tolist())
+        except Exception:  # noqa: BLE001 - fall through to repr
+            pass
+    if hasattr(obj, "item"):
+        try:
+            return obj.item()
+        except Exception:  # noqa: BLE001 - fall through to repr
+            pass
+    return repr(obj)
+
+
+_flight_recorder: Optional[FlightRecorder] = None
+
+
+def flight_recorder() -> Optional[FlightRecorder]:
+    """The installed process flight recorder (``None`` until a runtime
+    installs one — serving's freshness breach and the resilient
+    driver's escalations all dump through this handle)."""
+    return _flight_recorder
+
+
+def install_flight_recorder(path: str,
+                            capacity: Optional[int] = None
+                            ) -> Optional[FlightRecorder]:
+    """Create + install the process flight recorder (idempotent per
+    path: re-installing the same path returns the existing recorder so
+    its ring survives; a new path replaces it). Registers the
+    :func:`~.obs.record_event` tap so every structured event rings in
+    automatically. ``DETPU_BLACKBOX=0`` disables installation."""
+    global _flight_recorder
+    if not envvars.enabled(BLACKBOX_ENV):
+        return None
+    with _default_lock:
+        if _flight_recorder is not None and _flight_recorder.path == path:
+            return _flight_recorder
+        rec = FlightRecorder(path, capacity=capacity)
+        _flight_recorder = rec
+    from . import obs
+    obs.add_event_tap(_tap_event)
+    return rec
+
+
+def uninstall_flight_recorder() -> None:
+    """Drop the installed recorder (test isolation)."""
+    global _flight_recorder
+    with _default_lock:
+        _flight_recorder = None
+
+
+def _tap_event(kind: str, payload: Dict[str, Any]) -> None:
+    rec = _flight_recorder
+    if rec is not None:
+        rec.note_event(kind, **payload)

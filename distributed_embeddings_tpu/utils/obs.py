@@ -252,6 +252,22 @@ def reset_counters() -> None:
 # so a utils-level producer needs no logger plumbed through.
 _events: List[Dict[str, Any]] = []
 
+# observability-plane taps: callbacks that see every record_event() as it
+# happens, WITHOUT consuming it (drain_events stays the at-most-once
+# delivery path for drivers). The flight recorder (utils/mplane.py) rides
+# here so its black-box ring holds recent events with nobody polling.
+_event_taps: List[Any] = []
+
+
+def add_event_tap(fn) -> None:
+    """Register ``fn(kind, payload_dict)`` to observe every recorded
+    event (idempotent per function object). Taps must not raise; a
+    failing tap is dropped from the chain rather than poisoning every
+    later producer."""
+    with _counters_lock:
+        if fn not in _event_taps:
+            _event_taps.append(fn)
+
 
 def record_event(kind: str, **payload: Any) -> Dict[str, Any]:
     """Record one structured event (also bumps the ``event_<kind>``
@@ -259,7 +275,17 @@ def record_event(kind: str, **payload: Any) -> Dict[str, Any]:
     rec = {"event": kind, "time": time.time(), **payload}
     with _counters_lock:
         _events.append(rec)
+        taps = list(_event_taps)
     counter_inc(f"event_{kind}")
+    for fn in taps:
+        try:
+            fn(kind, dict(payload))
+        except Exception:  # noqa: BLE001 - a broken tap must not poison
+            # every event producer in the process
+            logger.exception("obs: event tap failed; removing it")
+            with _counters_lock:
+                if fn in _event_taps:
+                    _event_taps.remove(fn)
     return rec
 
 
@@ -332,16 +358,23 @@ class MetricsLogger:
 
     ``max_bytes`` (default ``DETPU_OBS_MAX_BYTES``; 0 = unbounded)
     bounds the sidecar for long resilient runs: when the file would
-    exceed the cap, it rotates to ``<path>.1`` (one generation kept —
-    the tail of history survives, the file can never grow without
-    bound) and logging continues into a fresh file. Rotation happens
-    between records, so both files stay line-parseable.
+    exceed the cap, it rotates through ``<path>.1`` .. ``<path>.N``
+    (``max_files`` generations, default ``DETPU_OBS_MAX_FILES`` = 2 —
+    the checkpoint-ring idiom: ``.1`` is the newest rotated generation,
+    ``.N`` the oldest, and the one past ``.N`` is dropped) and logging
+    continues into a fresh file. Total disk is therefore bounded by
+    ``(max_files + 1) * max_bytes`` however long the run lives.
+    Rotation happens between records, so every generation stays
+    line-parseable.
     """
 
-    def __init__(self, path: str, max_bytes: Optional[int] = None):
+    def __init__(self, path: str, max_bytes: Optional[int] = None,
+                 max_files: Optional[int] = None):
         self.path = path
         self.max_bytes = (envvars.get_int("DETPU_OBS_MAX_BYTES")
                           if max_bytes is None else int(max_bytes))
+        self.max_files = max(1, envvars.get_int("DETPU_OBS_MAX_FILES")
+                             if max_files is None else int(max_files))
         self._rec = _runtime.SectionRecorder(path)
 
     def _maybe_rotate(self) -> None:
@@ -353,9 +386,20 @@ class MetricsLogger:
             return
         if size < self.max_bytes:
             return
+        # shift the ring up one generation, oldest out first (same
+        # newest-first numbering as the checkpoint ring): .N drops,
+        # .i -> .(i+1), live -> .1
+        oldest = f"{self.path}.{self.max_files}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for i in range(self.max_files - 1, 0, -1):
+            src = f"{self.path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i + 1}")
         os.replace(self.path, self.path + ".1")
-        logger.info("obs: rotated metrics sidecar %s (> %d bytes)",
-                    self.path, self.max_bytes)
+        logger.info("obs: rotated metrics sidecar %s (> %d bytes; %d "
+                    "generation(s) kept)", self.path, self.max_bytes,
+                    self.max_files)
 
     def log_step(self, metrics: Dict[str, Any], step: Optional[int] = None,
                  **extra: Any) -> Dict[str, Any]:

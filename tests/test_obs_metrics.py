@@ -397,6 +397,51 @@ def test_metrics_logger_unbounded_by_default(tmp_path):
     assert len(obs.MetricsLogger.load(path)) == 30
 
 
+def test_metrics_logger_n_generation_rotation(tmp_path):
+    # max_files=3: .1/.2/.3 ride behind the live file (the checkpoint
+    # ring idiom); the record stream across ALL generations is the
+    # contiguous, ordered tail of everything logged
+    path = str(tmp_path / "metrics.jsonl")
+    cap = 1000
+    log = obs.MetricsLogger(path, max_bytes=cap, max_files=3)
+    for s in range(120):
+        log.log_step({"ids_routed": list(range(8))}, step=s)
+    gens = [p for p in (f"{path}.{i}" for i in range(1, 5))
+            if os.path.exists(p)]
+    assert gens == [f"{path}.{i}" for i in (1, 2, 3)]  # never a .4
+    assert os.path.getsize(path) <= cap + 200
+    recs = []
+    for p in reversed(gens):  # .3 oldest ... .1 newest rotated
+        recs.extend(obs.MetricsLogger.load(p))
+    recs.extend(obs.MetricsLogger.load(path))
+    steps = [r["step"] for r in recs]
+    assert steps == list(range(steps[0], 120))
+
+
+def test_metrics_logger_rotation_drops_oldest_generation(tmp_path):
+    # with max_files=1 every rotation REPLACES .1 — the oldest records
+    # fall off instead of a .2 appearing
+    path = str(tmp_path / "metrics.jsonl")
+    log = obs.MetricsLogger(path, max_bytes=500, max_files=1)
+    for s in range(80):
+        log.log_step({"ids_routed": list(range(8))}, step=s)
+    assert os.path.exists(path + ".1")
+    assert not os.path.exists(path + ".2")
+    tail = [r["step"] for r in obs.MetricsLogger.load(path + ".1")
+            + obs.MetricsLogger.load(path)]
+    assert tail == list(range(tail[0], 80))
+    assert tail[0] > 0  # something WAS dropped
+
+
+def test_metrics_logger_max_files_env_default(tmp_path, monkeypatch):
+    monkeypatch.setenv("DETPU_OBS_MAX_FILES", "4")
+    log = obs.MetricsLogger(str(tmp_path / "m.jsonl"), max_bytes=100)
+    assert log.max_files == 4
+    monkeypatch.delenv("DETPU_OBS_MAX_FILES")
+    log = obs.MetricsLogger(str(tmp_path / "m2.jsonl"), max_bytes=100)
+    assert log.max_files == 2  # registry default
+
+
 # ------------------------------------------------- sparse_optax metrics
 
 

@@ -735,3 +735,123 @@ def test_preempt_step_parsing(monkeypatch):
     assert runtime.preempt_step() is None
     monkeypatch.delenv(runtime.FAULT_ENV)
     assert runtime.preempt_step() is None
+
+
+# ------------------------------------------------- the crash flight recorder
+
+
+@pytest.fixture()
+def _blackbox_isolation():
+    from distributed_embeddings_tpu.utils import mplane
+    mplane.uninstall_flight_recorder()
+    yield
+    mplane.uninstall_flight_recorder()
+
+
+def test_rollback_exhaustion_dumps_blackbox(tmp_path, _blackbox_isolation):
+    """A terminal escalation leaves a CRC-intact post-mortem beside the
+    checkpoint naming the trigger, with the recovery events ringed in
+    (the tentpole's black-box contract)."""
+    from distributed_embeddings_tpu.parallel import resilient as rz
+    from distributed_embeddings_tpu.utils import mplane
+
+    de, tx, emb_opt, state, step = _build(nan_guard=True)
+    ck = str(tmp_path / "ck")
+    with pytest.raises(runtime.NonFiniteLossError):
+        run_resilient(step, state, _stream(10, bad=set(range(4, 10))),
+                      de=de, checkpoint_dir=ck, checkpoint_every_steps=2,
+                      resume=True, emb_optimizer=emb_opt, dense_tx=tx,
+                      escalate_after=2, keep_last_n=2, rollback_max=1,
+                      quarantine_max=4)
+    path = rz.blackbox_path(ck)
+    payload = mplane.verify_blackbox(path)
+    assert payload["trigger"] == "rollback_exhaustion"
+    assert payload["context"]["rollbacks"] == 1
+    assert payload["context"]["quarantined"] == [4, 5]
+    # the recovery events rode the obs tap into the ring
+    kinds = {e["event"] for e in payload["events"]}
+    assert "training_rollback" in kinds
+    assert "batch_quarantined" in kinds
+
+
+def test_quarantine_exhaustion_dumps_blackbox(tmp_path,
+                                              _blackbox_isolation):
+    from distributed_embeddings_tpu.parallel import resilient as rz
+    from distributed_embeddings_tpu.utils import mplane
+
+    de, tx, emb_opt, state, step = _build(nan_guard=True)
+    ck = str(tmp_path / "ck")
+    with pytest.raises(runtime.NonFiniteLossError,
+                       match="quarantine budget"):
+        run_resilient(step, state, _stream(10, bad={4, 5}), de=de,
+                      checkpoint_dir=ck, checkpoint_every_steps=2,
+                      resume=True, emb_optimizer=emb_opt, dense_tx=tx,
+                      escalate_after=2, keep_last_n=2, quarantine_max=1)
+    payload = mplane.verify_blackbox(rz.blackbox_path(ck))
+    assert payload["trigger"] == "quarantine_exhaustion"
+
+
+def test_preemption_dumps_blackbox(tmp_path, monkeypatch,
+                                   _blackbox_isolation):
+    from distributed_embeddings_tpu.parallel import resilient as rz
+    from distributed_embeddings_tpu.utils import mplane
+
+    de, tx, emb_opt, state, step = _build(with_metrics=False)
+    ck = str(tmp_path / "ck")
+    monkeypatch.setenv(runtime.FAULT_ENV, "preempt@3")
+    r = run_resilient(step, state, _driver_data, de=de,
+                      checkpoint_dir=ck, emb_optimizer=emb_opt,
+                      dense_tx=tx, exit_on_preempt=False)
+    monkeypatch.delenv(runtime.FAULT_ENV)
+    assert r.preempted
+    payload = mplane.verify_blackbox(rz.blackbox_path(ck))
+    assert payload["trigger"] == "preemption"
+    assert payload["context"]["step"] == r.step
+
+
+def test_unhandled_crash_dumps_blackbox(tmp_path, _blackbox_isolation):
+    """ANY exception escaping the train loop leaves a post-mortem with
+    the ringed step metrics and the error named — the last line of
+    defense."""
+    from distributed_embeddings_tpu.parallel import resilient as rz
+    from distributed_embeddings_tpu.utils import mplane
+
+    de, tx, emb_opt, state, step = _build()
+    ck = str(tmp_path / "ck")
+
+    def data(start):
+        for i in range(start, 10):
+            if i == 3:
+                raise RuntimeError("disk on fire")
+            yield _batch(i)
+
+    with pytest.raises(RuntimeError, match="disk on fire"):
+        run_resilient(step, state, data, de=de, checkpoint_dir=ck,
+                      metrics_interval=1, save_on_exit=False)
+    payload = mplane.verify_blackbox(rz.blackbox_path(ck))
+    assert payload["trigger"] == "unhandled_crash"
+    assert "disk on fire" in payload["context"]["error"]
+    assert payload["context"]["error_type"] == "RuntimeError"
+    # metrics_interval=1: the ring holds the pre-crash step summaries
+    assert [s["step"] for s in payload["steps"]] == [0, 1, 2]
+
+
+def test_blackbox_disabled_by_env(tmp_path, monkeypatch,
+                                  _blackbox_isolation):
+    from distributed_embeddings_tpu.parallel import resilient as rz
+    from distributed_embeddings_tpu.utils import mplane
+
+    monkeypatch.setenv(mplane.BLACKBOX_ENV, "0")
+    de, tx, emb_opt, state, step = _build(with_metrics=False)
+    ck = str(tmp_path / "ck")
+
+    def data(start):
+        for i in range(start, 5):
+            if i == 2:
+                raise RuntimeError("quiet crash")
+            yield _batch(i)
+
+    with pytest.raises(RuntimeError):
+        run_resilient(step, state, data, de=de, checkpoint_dir=ck,
+                      save_on_exit=False)
+    assert not os.path.exists(rz.blackbox_path(ck))

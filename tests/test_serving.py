@@ -19,6 +19,8 @@ The semantics under test (``parallel/serving.py``):
   bitwise-unchanged by any amount of serving.
 """
 
+import json
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -32,7 +34,7 @@ from distributed_embeddings_tpu.parallel import (
     make_hybrid_train_step)
 from distributed_embeddings_tpu.parallel import serving as sv
 from distributed_embeddings_tpu.parallel import streaming as smod
-from distributed_embeddings_tpu.utils import obs
+from distributed_embeddings_tpu.utils import mplane, obs
 
 
 class ManualClock:
@@ -593,6 +595,122 @@ def test_stats_surface():
     s = rt.stats()
     for k in ("served", "shed", "deadline_missed", "pad_fraction",
               "queue_depth_p95", "latency_p99_ms", "level_name",
-              "steady_state_recompiles", "warmup_compiles"):
+              "steady_state_recompiles", "warmup_compiles",
+              "latency_stages_ms", "p99_dominant_stage"):
         assert k in s
     assert s["level_name"] == "healthy"
+
+
+# ---------------------------------------------- observability plane views
+
+
+class TickClock(ManualClock):
+    """Monotone clock that advances a hair on every read, so the flush
+    timestamps (t0/t_pack/t_disp/t_dev/t1) are strictly increasing and
+    every decomposition span is nonzero."""
+
+    def __call__(self) -> float:
+        self.t += 1e-4
+        return self.t
+
+
+def _build_ticking(**cfg_kw):
+    de, state, rt, clock = _build(**cfg_kw)
+    tick = TickClock()
+    tick.t = clock.t
+    rt._clock = tick
+    return de, state, rt, tick
+
+
+def _drive_obs(rt, clock, rng, rounds=40):
+    lats = []
+    for i in range(rounds):
+        assert rt.submit(_req(rng, n=2)) is None
+        # varied queue waits, all past max_wait_ms so every round
+        # flushes exactly its own request (counts stay exact)
+        clock.t += 0.006 + 0.0015 * (i % 9)
+        for r in rt.poll():
+            assert isinstance(r, Served)
+            lats.append(r.latency_ms)
+    return lats
+
+
+def test_served_spans_sum_exactly_to_latency():
+    de, state, rt, clock = _build_ticking()
+    rt.warmup(_tmpl())
+    rng = np.random.default_rng(0)
+    seen = 0
+    for i in range(6):
+        assert rt.submit(_req(rng, n=2)) is None
+        clock.t += 0.007
+        for r in rt.poll():
+            assert isinstance(r, Served)
+            assert set(r.spans) == {"queue_wait_ms", "coalesce_ms",
+                                    "dispatch_ms", "device_compute_ms",
+                                    "reply_slice_ms"}
+            # the five stages are a PARTITION of the request's life:
+            # they sum to the end-to-end latency by construction
+            assert sum(r.spans.values()) == pytest.approx(
+                r.latency_ms, rel=1e-9)
+            assert all(v >= 0.0 for v in r.spans.values())
+            assert r.spans["queue_wait_ms"] > 0
+            seen += 1
+    assert seen == 6
+
+
+def test_stats_sketch_percentiles_match_numpy_reference():
+    # the serving battery's pin: sketch-backed stats() percentiles sit
+    # within the sketch's guaranteed relative error of the numpy
+    # reference over the SAME samples (method="lower" = the exact order
+    # statistic at the sketch's rank definition, q * (count - 1))
+    de, state, rt, clock = _build_ticking()
+    rt.warmup(_tmpl())
+    rng = np.random.default_rng(2)
+    lats = _drive_obs(rt, clock, rng, rounds=60)
+    assert len(lats) == 60
+    s = rt.stats()
+    arr = np.asarray(lats, np.float64)
+    for key, q in (("latency_p50_ms", 50), ("latency_p95_ms", 95),
+                   ("latency_p99_ms", 99)):
+        ref = float(np.percentile(arr, q, method="lower"))
+        assert s[key] == pytest.approx(ref, rel=0.011), key
+
+
+def test_stage_decomposition_accounts_for_total_latency():
+    de, state, rt, clock = _build_ticking()
+    rt.warmup(_tmpl())
+    rng = np.random.default_rng(3)
+    lats = _drive_obs(rt, clock, rng, rounds=30)
+    s = rt.stats()
+    stages = s["latency_stages_ms"]
+    assert set(stages) == set(sv.STAGES)
+    for st in stages.values():
+        assert st["count"] == len(lats)
+        assert {"p50", "p95", "p99", "mean", "sum"} <= set(st)
+    # per-request spans partition the latency, so the per-stage sketch
+    # SUMS add up to the total served latency (exactly — sums are not
+    # bucketed)
+    total = sum(st["sum"] for st in stages.values())
+    assert total == pytest.approx(sum(lats), rel=1e-9)
+    assert s["p99_dominant_stage"] in stages
+    # with these injected waits the queue dominates the tail
+    assert s["p99_dominant_stage"] == "queue_wait"
+
+
+def test_serving_registry_prometheus_surface():
+    de, state, rt, clock = _build_ticking()
+    rt.warmup(_tmpl())
+    rng = np.random.default_rng(4)
+    _drive_obs(rt, clock, rng, rounds=10)
+    text = rt.metrics.render()
+    assert "# TYPE detpu_serve_latency_ms summary" in text
+    assert "detpu_serve_latency_ms_count 10" in text
+    assert 'detpu_serve_stage_ms{stage="queue_wait",quantile="0.99"}' \
+        in text
+    assert 'detpu_serve_total{outcome="served"} 10' in text
+    assert "detpu_serve_level 0" in text
+    assert "detpu_serve_steady_state_recompiles 0" in text
+    # the registry snapshot round-trips through JSON in mergeable form
+    doc = json.loads(json.dumps(rt.metrics.to_dict()))
+    lat = doc["detpu_serve_latency_ms"]["series"][0]["value"]
+    assert mplane.QuantileSketch.from_dict(lat).count == 10

@@ -1,0 +1,283 @@
+#!/usr/bin/env python
+"""Verify gate for the production observability plane (run by ``make
+check-obsplane`` inside ``make verify``) — the p99-attribution and
+black-box drill.
+
+CPU end-to-end, two child processes on the 8-virtual-device mesh:
+
+1. **Scrape-under-chaos drill**: the child builds the serving model,
+   warms the ladder, starts the Prometheus scrape endpoint on an
+   ephemeral port, and drives a seeded request stream under
+   ``DETPU_FAULT=slow:serve_step:<s>,burst@<pos>`` (the same degraded
+   backend + QPS spike the serving gate uses). MID-LOAD it scrapes
+   ``GET /metrics`` over real HTTP and checks the body is valid
+   Prometheus text carrying the serve latency summary. After the drive,
+   the per-stage latency sketches (queue-wait / coalesce / dispatch /
+   device-compute / reply-slice) must SUM to the total served latency
+   within 5% — the p99-decomposition instrument is only trustworthy if
+   the stages partition the end-to-end time. 0 steady-state recompiles
+   throughout: observing must never retrace.
+2. **Black-box drill**: a training child runs under
+   ``DETPU_FAULT=nan@<pos>`` with a one-shot stream (rollback
+   impossible, so the NaN storm is terminal). The escalation must leave
+   a CRC-intact ``<dir>.blackbox.json`` whose payload names the trigger
+   (``nan_escalation``), the unhealthy table(s) via the per-table
+   health sentinels, and carries the ringed pre-crash step metrics.
+
+Exit 0 when both drills pass; 1 with a readable reason otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WORLD = 8
+BURST_AT = 1      # second of the stream the QPS spike hits
+BURST_X = 8       # arrival-rate multiplier during the burst
+SLOW_S = 0.02     # injected per-flush latency (the degraded backend)
+QPS = 40.0
+DURATION_S = 2.0
+NAN_AT = 3        # stream position the poisoned batch hits
+
+_SERVE_CHILD = """
+import sys, urllib.request
+sys.path.insert(0, {repo!r})
+import numpy as np, jax, jax.numpy as jnp, optax
+jax.config.update('jax_platforms', 'cpu')
+from jax.sharding import Mesh
+from distributed_embeddings_tpu.parallel import (
+    DistributedEmbedding, ServeConfig, ServingRuntime, SparseSGD,
+    init_hybrid_state)
+from distributed_embeddings_tpu.parallel import serving as sv
+from distributed_embeddings_tpu.utils import mplane
+
+world = {world}
+mesh = Mesh(np.array(jax.devices()[:world]), ("data",))
+sizes = [20000, 10000, 10000, 5000, 5000, 2000, 2000, 1000]
+configs = [{{"input_dim": v, "output_dim": 8}} for v in sizes]
+de = DistributedEmbedding(configs, world_size=world)
+tx = optax.sgd(0.05)
+state = init_hybrid_state(de, SparseSGD(),
+                          {{"w": jnp.ones((8 * len(configs) + 2, 1),
+                                          jnp.float32) * 0.01}},
+                          tx, jax.random.key(0), mesh=mesh)
+
+def pred_fn(dp, outs, batch):
+    x = jnp.concatenate(list(outs) + [batch], axis=-1)
+    return jax.nn.sigmoid(x @ dp["w"])[:, 0]
+
+cfg = ServeConfig(max_batch=32, max_wait_ms=5, deadline_ms=2000,
+                  max_queue=64, shed_frac=0.5)
+rt = ServingRuntime(de, pred_fn, state, mesh=mesh, config=cfg)
+rng = np.random.default_rng(0)
+tmpl = sv.synthetic_request(rng, sizes, 2, numerical=2)
+rt.warmup((tmpl.cats, tmpl.batch))
+
+exp = mplane.start_http_exporter(rt.metrics, port=0)
+
+def make_request(i):
+    return sv.synthetic_request(rng, sizes, int(rng.integers(1, 5)),
+                                numerical=2)
+
+served = []
+def collect(res):
+    served.extend(r for r in res if isinstance(r, sv.Served))
+
+collect(sv.drive(rt, make_request, {qps}, {duration}, burst_x={burst_x}))
+
+# ---- MID-LOAD scrape: the queue refills, then a real HTTP GET ------
+for _ in range(8):
+    rt.submit(make_request(-1))
+with urllib.request.urlopen(exp.url(), timeout=30) as resp:
+    ctype = resp.headers["Content-Type"]
+    body = resp.read().decode("utf-8")
+collect(rt.poll())
+collect(sv.drive(rt, make_request, {qps}, 0.5, burst_positions=()))
+collect(rt.flush())
+exp.stop()
+
+# valid Prometheus text: every sample line is "name[labels] value"
+samples = 0
+scrape_ok = 1 if ctype.startswith("text/plain") else 0
+for ln in body.splitlines():
+    if not ln or ln.startswith("#"):
+        continue
+    try:
+        float(ln.rsplit(None, 1)[1])
+        samples += 1
+    except (IndexError, ValueError):
+        scrape_ok = 0
+
+s = rt.stats()
+total_lat = sum(r.latency_ms for r in served)
+stage_total = sum(st["sum"] for st in s["latency_stages_ms"].values())
+ratio = stage_total / total_lat if total_lat else -1.0
+print("FINAL",
+      "SERVED", s["served"],
+      "SCRAPE_OK", scrape_ok,
+      "SCRAPE_SAMPLES", samples,
+      "SCRAPE_HAS_LAT", int("detpu_serve_latency_ms_count" in body),
+      "SCRAPE_HAS_STAGE", int('detpu_serve_stage_ms' in body),
+      "STAGE_RATIO", round(ratio, 4),
+      "DOMINANT", s["p99_dominant_stage"],
+      "STEADY", s["steady_state_recompiles"], flush=True)
+"""
+
+_NAN_CHILD = """
+import sys
+sys.path.insert(0, {repo!r})
+import numpy as np, jax, jax.numpy as jnp, optax
+jax.config.update('jax_platforms', 'cpu')
+from distributed_embeddings_tpu.parallel import (
+    DistributedEmbedding, SparseAdagrad, init_hybrid_state,
+    make_hybrid_train_step, run_resilient)
+from distributed_embeddings_tpu.parallel import resilient as rz
+from distributed_embeddings_tpu.utils import mplane, runtime
+
+configs = [{{"input_dim": 20 + 3 * i, "output_dim": 4}}
+           for i in range(6)]
+de = DistributedEmbedding(configs, world_size=1)
+emb_opt = SparseAdagrad()
+tx = optax.sgd(0.1)
+state = init_hybrid_state(de, emb_opt, {{"w": jnp.float32(0.5)}}, tx,
+                          jax.random.key(0))
+
+def loss_fn(dp, outs, batch):
+    return (sum(jnp.mean(o) for o in outs) * dp["w"]
+            - jnp.mean(batch)) ** 2
+
+step = make_hybrid_train_step(de, loss_fn, tx, emb_opt,
+                              with_metrics=True)
+
+def data():  # ONE-SHOT: rollback impossible -> the NaN storm is terminal
+    for i in range(10):
+        rng = np.random.default_rng(i)
+        cats = [jnp.asarray(rng.integers(0, c["input_dim"], 16),
+                            jnp.int32) for c in configs]
+        y = jnp.asarray(rng.normal(size=(16,)), jnp.float32)
+        yield cats, y
+
+ck = {ckpt!r}
+try:
+    run_resilient(step, state, data(), de=de, checkpoint_dir=ck,
+                  escalate_after=2, metrics_interval=1,
+                  save_on_exit=False)
+    print("FINAL CRASHED 0", flush=True)
+    sys.exit(0)
+except runtime.NonFiniteLossError:
+    pass
+payload = mplane.verify_blackbox(rz.blackbox_path(ck))  # raises on CRC
+print("FINAL",
+      "CRASHED", 1,
+      "TRIGGER", payload["trigger"],
+      "UNHEALTHY", len(payload["context"].get("unhealthy_tables", [])),
+      "STEPS_RING", len(payload["steps"]), flush=True)
+"""
+
+
+def _run_child(code: str, extra_env: dict) -> dict:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for k in ("DETPU_OBS", "DETPU_TELEMETRY", "DETPU_METRICS_PORT"):
+        env.pop(k, None)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + f" --xla_force_host_platform_device_count={WORLD}")
+    env.update(extra_env)
+    p = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=900)
+    if p.returncode != 0:
+        raise RuntimeError(f"drill child failed rc={p.returncode}: "
+                           f"{(p.stderr or p.stdout).strip()[-1200:]}")
+    for line in reversed(p.stdout.strip().splitlines()):
+        if line.startswith("FINAL"):
+            parts = line.split()
+            return dict(zip(parts[1::2], parts[2::2]))
+    raise RuntimeError("drill child printed no FINAL line: "
+                       f"{p.stdout.strip()[-800:]}")
+
+
+def main() -> int:
+    errors = []
+
+    # ---- drill 1: scrape + p99 decomposition under burst chaos -------
+    try:
+        got = _run_child(
+            _SERVE_CHILD.format(repo=REPO, world=WORLD, qps=QPS,
+                                duration=DURATION_S, burst_x=BURST_X),
+            {"DETPU_FAULT": f"slow:serve_step:{SLOW_S},burst@{BURST_AT}",
+             "DETPU_SERVE_BURST_X": str(BURST_X)})
+    except RuntimeError as e:
+        return _fail([str(e)])
+    if int(got.get("SERVED", 0)) <= 0:
+        errors.append("scrape drill served nothing")
+    if got.get("SCRAPE_OK") != "1" or int(got.get("SCRAPE_SAMPLES", 0)) < 10:
+        errors.append(
+            f"mid-load scrape is not valid Prometheus text "
+            f"(ok={got.get('SCRAPE_OK')}, "
+            f"samples={got.get('SCRAPE_SAMPLES')})")
+    if got.get("SCRAPE_HAS_LAT") != "1" or got.get("SCRAPE_HAS_STAGE") != "1":
+        errors.append(
+            "the scrape body is missing the serve latency / stage "
+            "summaries — the runtime's registry is not on the endpoint")
+    ratio = float(got.get("STAGE_RATIO", -1))
+    if not (0.95 <= ratio <= 1.05):
+        errors.append(
+            f"per-stage latency sums / end-to-end served latency = "
+            f"{ratio} — outside [0.95, 1.05]: the stage decomposition "
+            "does not partition the request's life, so p99 attribution "
+            "cannot be trusted")
+    if got.get("DOMINANT") in (None, "None"):
+        errors.append("stats() attributed the p99 tail to no stage")
+    if got.get("STEADY") != "0":
+        errors.append(
+            f"{got.get('STEADY')} steady-state recompile(s) during the "
+            "observed drill — observing must never retrace")
+
+    # ---- drill 2: NaN escalation leaves a CRC-intact black box -------
+    with tempfile.TemporaryDirectory() as tmp:
+        try:
+            got2 = _run_child(
+                _NAN_CHILD.format(repo=REPO,
+                                  ckpt=os.path.join(tmp, "ck")),
+                {"DETPU_FAULT": f"nan@{NAN_AT},nan@{NAN_AT + 1}"})
+        except RuntimeError as e:
+            return _fail(errors + [str(e)])
+    if got2.get("CRASHED") != "1":
+        errors.append("nan@ injection did not escalate terminally")
+    elif got2.get("TRIGGER") != "nan_escalation":
+        errors.append(
+            f"black box names trigger {got2.get('TRIGGER')!r}, expected "
+            "'nan_escalation'")
+    elif int(got2.get("UNHEALTHY", 0)) < 1:
+        errors.append(
+            "the black box names NO unhealthy table — the per-table "
+            "health sentinels did not reach the post-mortem")
+    elif int(got2.get("STEPS_RING", 0)) < 1:
+        errors.append(
+            "the black box carries no ringed step metrics — the "
+            "pre-crash history is missing")
+
+    if errors:
+        return _fail(errors)
+    print(f"check_obsplane: OK (scraped {got['SCRAPE_SAMPLES']} samples "
+          f"mid-load under burst@{BURST_AT}s x{BURST_X}, stage sums / "
+          f"total latency = {got['STAGE_RATIO']} (p99 tail -> "
+          f"{got['DOMINANT']}), 0 steady-state recompiles; nan@{NAN_AT} "
+          f"left a CRC-intact black box naming {got2['UNHEALTHY']} "
+          f"unhealthy table(s) with {got2['STEPS_RING']} ringed steps)")
+    return 0
+
+
+def _fail(errors) -> int:
+    for e in errors:
+        print(f"check_obsplane: {e}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -649,6 +649,81 @@ def check_online(old: Dict[str, Any], new: Dict[str, Any]) -> int:
     return failures
 
 
+#: max tolerated growth of the observability plane's own costs
+#: (stats() wall time, HTTP scrape round-trip, black-box dump). These
+#: are microsecond/millisecond-scale host measurements with real
+#: scheduler noise, so the ratchet is deliberately looser than the 10%
+#: throughput gate: a 2x jump is a structural regression (the plane
+#: grew a sort, a lock convoy, or an O(window) path back), not jitter.
+OBS_PLANE_COST_TOL = 1.0
+#: per-metric noise floors: below these absolute baselines the ratchet
+#: is skipped — doubling a 3us stats() call is timer noise, doubling a
+#: 300us one is a regression
+OBS_PLANE_FLOORS = {
+    "stats_wall_us": 20.0,
+    "scrape_ms": 0.25,
+    "dump_ms": 0.25,
+}
+
+
+def check_obs_plane(old: Dict[str, Any], new: Dict[str, Any]) -> int:
+    """Gate the ``obs_plane`` section (ISSUE 17): the observability
+    plane must stay an instrument, not a workload.
+
+    * a failed scrape (``scrape_ok`` != 1) fails outright — the
+      Prometheus endpoint served garbage or nothing while the section
+      ran;
+    * nonzero ``steady_state_recompiles`` fails — observing a warmed
+      serving ladder must never retrace it;
+    * each cost in :data:`OBS_PLANE_FLOORS` growing beyond
+      :data:`OBS_PLANE_COST_TOL` versus a baseline above its noise
+      floor fails — the cost ratchet on the plane's own read, scrape,
+      and crash-dump paths;
+    * a candidate missing the section while the baseline has it fails
+      (the cost measurement crashed or was dropped — absence would hide
+      exactly the regressions this gate watches).
+
+    The serving latencies the plane *measures* are gated separately by
+    :func:`check_serving`; this gate prices the measuring itself.
+    """
+    sec = new.get("obs_plane")
+    if not isinstance(sec, dict):
+        if isinstance(old.get("obs_plane"), dict):
+            print("compare_bench: candidate has no 'obs_plane' section "
+                  "but the baseline does — the observability-plane cost "
+                  "measurement failed or was dropped", file=sys.stderr)
+            return 1
+        return 0
+    failures = 0
+    if sec.get("scrape_ok") != 1:
+        print("compare_bench: obs_plane scrape_ok != 1 — the Prometheus "
+              "scrape endpoint failed while the section ran",
+              file=sys.stderr)
+        failures += 1
+    rc = sec.get("steady_state_recompiles")
+    if isinstance(rc, (int, float)) and rc > 0:
+        print(f"compare_bench: obs_plane section recompiled {int(rc)} "
+              "time(s) at steady state — observing the serving ladder "
+              "retraced it", file=sys.stderr)
+        failures += 1
+    osec = old.get("obs_plane")
+    if isinstance(osec, dict):
+        for key, floor in OBS_PLANE_FLOORS.items():
+            ov, nv = osec.get(key), sec.get(key)
+            if not isinstance(ov, (int, float)) \
+                    or not isinstance(nv, (int, float)):
+                continue
+            if ov >= floor and nv > ov * (1.0 + OBS_PLANE_COST_TOL):
+                print(f"compare_bench: obs_plane REGRESSION: {key} "
+                      f"{ov:.2f} -> {nv:.2f} "
+                      f"(+{(nv / ov - 1) * 100:.0f}%) — the plane's own "
+                      "cost grew past the "
+                      f"{OBS_PLANE_COST_TOL * 100:.0f}% ratchet",
+                      file=sys.stderr)
+                failures += 1
+    return failures
+
+
 def compare(old: Dict[str, Any], new: Dict[str, Any],
             threshold: float) -> int:
     steady_failures = check_steady_state(new)
@@ -662,6 +737,7 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
     steady_failures += check_streaming(old, new)
     steady_failures += check_serving(old, new)
     steady_failures += check_online(old, new)
+    steady_failures += check_obs_plane(old, new)
     regressions = 0
     rows = []
     for keys, higher_better in ((THROUGHPUT_KEYS, True), (MS_KEYS, False)):
