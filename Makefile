@@ -7,8 +7,8 @@ SHELL := /bin/bash
         verify lint plan-audit audit-step hlo-audit schedule-audit \
         check-backend check-obs check-obs-report check-resilience \
         check-reshard check-recovery check-streaming check-serving \
-        check-online check-obsplane check-phase-profile obs-report \
-        phase-profile
+        check-online check-obsplane check-phase-profile check-isolation \
+        obs-report phase-profile
 
 all: native
 
@@ -33,7 +33,7 @@ bench:
 verify: lint plan-audit audit-step hlo-audit schedule-audit check-backend \
         check-obs check-obs-report check-phase-profile check-resilience \
         check-reshard check-recovery check-streaming check-serving \
-        check-online check-obsplane
+        check-online check-obsplane check-isolation
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -155,6 +155,15 @@ check-serving:
 # the same stream without serving (parallel/online.py)
 check-online:
 	python tools/check_online.py
+
+# process-isolation drill: a real spawned world-8 serving worker is
+# SIGKILLed mid-burst (DETPU_FAULT=die@<rid> in the WORKER env only);
+# the supervisor must contain the crash (typed Unavailable, zero lost
+# futures), restart within the backoff budget, dump a CRC-intact
+# blackbox, resume full service at 0 steady-state recompiles, and keep
+# training CRC-identical to the serving-free run; tools/check_isolation.py
+check-isolation:
+	python tools/check_isolation.py
 
 # observability-plane drill: a world-8 child serves under burst chaos
 # while its Prometheus endpoint is scraped MID-LOAD over real HTTP; the

@@ -1360,8 +1360,11 @@ def run_online():
     trainer and the serving coalescer in ONE process against ONE set of
     tables, RCU snapshots published on a fixed cadence
     (``parallel/online.py``). The planted per-id CTR stream trains while
-    step-paced Zipfian requests serve from the published snapshots at a
-    FIXED staleness budget (publish cadence 2, freshness SLO 4 steps).
+    a WALL-CLOCK open-loop driver (``RealtimeDriver`` on its own thread
+    of control, ISSUE 18) serves Zipfian requests from the published
+    snapshots at a FIXED staleness budget (publish cadence 2, freshness
+    SLO 4 steps) — so ``freshness_p95_s`` here measures true concurrent
+    staleness, not step-paced pumping.
 
     Reported: the JOINT rates over one wall clock (train samples/s and
     serve QPS — the price of serving and publishing inside the training
@@ -1395,8 +1398,11 @@ def run_online():
     steps = 8 if SMOKE else 80
     publish_every = 2
     slo_steps = 4
-    rps = 4                       # serve requests per train step
+    rps = 4                       # sizing unit for the serve config
     req_n = 16 if SMOKE else 64   # samples per request
+    # wall-clock arrival rate: roughly the old step-paced volume (a few
+    # requests per train step) so the joint-throughput baselines carry
+    qps = 30.0 if SMOKE else 8.0
     rng0 = np.random.default_rng(17)
     logits = rng0.normal(size=(vocab,)).astype(np.float32) * 2.0
 
@@ -1485,7 +1491,7 @@ def run_online():
                               np.zeros(req_n, np.int32)], None),
             make_request=lambda i: sv.synthetic_request(
                 rng, [vocab, 100], req_n),
-            requests_per_step=rps, on_step=mark,
+            realtime_qps=qps, realtime_drain_s=60.0, on_step=mark,
             streaming_state=sstate, emb_optimizer=emb_opt, dense_tx=tx,
             checkpoint_every_steps=max(steps // 4, 2),
             metrics_interval=0)
@@ -1541,7 +1547,8 @@ def run_online():
         "steady_state_recompiles": int(s["steady_state_recompiles"]),
         "level": s["level"],
         "vocab": vocab, "capacity": capacity, "batch": batch,
-        "steps": steps, "requests_per_step": rps, "request_n": req_n,
+        "steps": steps, "serve_mode": "realtime_open_loop",
+        "realtime_qps": qps, "request_n": req_n,
     }
 
 
@@ -1677,6 +1684,181 @@ def run_obs_plane():
         "requests": requests,
         "steady_state_recompiles": int(
             rt.stats()["steady_state_recompiles"]),
+    }
+
+
+def run_isolated_serving():
+    """Process-isolated serving section (ISSUE 18): what the process
+    boundary costs and what the supervision buys, on the SAME model the
+    ``tools/check_isolation.py`` drill uses.
+
+    Three measurements over one wall-clock request factory:
+
+    * **in-process baseline** — a warmed ``ServingRuntime`` driven by
+      the open-loop driver; its served p50/p95/p99 are the floor;
+    * **out-of-process** — a real spawned supervisor worker serving the
+      same stream over the socket + shm boundary WHILE the trainer
+      trains and publishes snapshots through shared memory (the joint
+      train rate is the price of supervision inside the training
+      process); the worker is killed mid-stream (``die@`` in the
+      WORKER's env only) so crash containment, restart backoff, and
+      restart-to-first-served are measured, not assumed;
+    * **the supervision stats** — shm publish p95, restart count,
+      typed-Unavailable outage answers, and request-rid conservation
+      across the crash.
+
+    ``tools/compare_bench.py::check_isolated_serving`` fails a record
+    whose worker never restarted, whose futures leaked, whose reborn
+    worker retraced, or whose boundary overhead blew past the
+    in-process floor."""
+    from distributed_embeddings_tpu.parallel import (
+        RealtimeDriver, Served, ServingRuntime, SparseSGD,
+        SuperviseConfig, Supervisor, Unavailable, run_resilient)
+    from tools import isolation_common as ic
+
+    global _STEADY_RECOMPILES
+    qps = 60.0 if SMOKE else 80.0
+    dur = 1.5 if SMOKE else 3.0
+    steps = 12 if SMOKE else 30
+    rows = 64                      # training batch rows
+    die_at = max(10, int(qps * dur / 2))
+
+    def pct(results):
+        lats = np.array([r_.latency_ms for r_ in results
+                         if isinstance(r_, Served)])
+        if lats.size == 0:
+            return {"p50_ms": None, "p95_ms": None, "p99_ms": None,
+                    "served": 0}
+        return {"p50_ms": round(float(np.percentile(lats, 50)), 3),
+                "p95_ms": round(float(np.percentile(lats, 95)), 3),
+                "p99_ms": round(float(np.percentile(lats, 99)), 3),
+                "served": int(lats.size)}
+
+    # ---- in-process floor: same model, same stream, no boundary
+    built = ic.build(world=1)
+    rt = ServingRuntime(built["de"], built["pred_fn"], built["state"],
+                        config=built["config"],
+                        streaming=built["streaming"])
+    rt.warmup(built["template"])
+    rt.install_snapshot(built["state"],
+                        jax.tree.map(np.asarray, built["streaming"][1]),
+                        version=1, train_step=0)
+    drv = RealtimeDriver(rt, ic.make_request_fn(seed=21), qps,
+                         duration_s=dur, burst_positions=(),
+                         drain_s=30.0)
+    drv.start()
+    drv.join(timeout=120)
+    inproc = pct(drv.results())
+    _STEADY_RECOMPILES += rt.steady_recompiles()
+
+    # ---- out-of-process: supervised worker + joint training + crash
+    sup = Supervisor(
+        "tools.isolation_common:worker_factory", {"world": 1},
+        config=SuperviseConfig(
+            env={"JAX_PLATFORMS": "cpu", "DETPU_FAULT": f"die@{die_at}",
+                 "DETPU_METRICS_PORT": ""}))
+    t0 = time.perf_counter()
+    sup.start()
+    start_s = time.perf_counter() - t0
+    built2 = ic.build(world=1)
+    sup.install_snapshot(built2["state"], built2["streaming"][1],
+                         version=1, train_step=0)
+    drv2 = RealtimeDriver(sup, ic.make_request_fn(seed=22), qps,
+                          duration_s=None, burst_positions=(),
+                          drain_s=60.0)
+    drv2.start()
+
+    def loss_fn(dp, outs, b):
+        return sum(b[:, i % 2].mean() * jnp.mean(o)
+                   for i, o in enumerate(outs)) * jnp.mean(dp["w"])
+
+    step = make_hybrid_train_step(built2["de"], loss_fn, optax.sgd(0.05),
+                                  SparseSGD(), with_metrics=True,
+                                  nan_guard=True, dynamic=built2["scfg"])
+
+    def make_batch(i):
+        r_ = np.random.default_rng(4200 + i)
+        cats = [jnp.asarray(r_.integers(0, sz, rows), jnp.int32)
+                for sz in ic.SIZES]
+        cats.append(jnp.asarray(
+            r_.integers(i, i + 6, rows) * 7 + 10_000_000, jnp.int32))
+        return cats, jnp.asarray(r_.normal(size=(rows, 2)), jnp.float32)
+
+    def data(start):
+        for i in range(start, steps):
+            yield make_batch(i)
+
+    marks, vc = {}, {"v": 1}
+
+    def mark(cur, loss, metrics, state_now):
+        marks[cur] = time.perf_counter()
+
+    def pump(cur, loss, metrics, state_now, telem, stream):
+        if cur % 2 == 0:
+            vc["v"] += 1
+            sup.install_snapshot(state_now, stream, version=vc["v"],
+                                 train_step=cur)
+        sup.note_train_step(cur)
+
+    res = run_resilient(step, built2["state"], data, de=built2["de"],
+                        on_step=mark, on_step_aux=pump,
+                        emb_optimizer=SparseSGD(),
+                        dense_tx=optax.sgd(0.05),
+                        streaming_state=built2["streaming"][1],
+                        metrics_interval=0)
+    last = max(marks)
+    train_sps = rows * (last - 1) / (marks[last] - marks[1])
+
+    # the driver keeps the stream open until the crash has been
+    # contained and the reborn worker serves again
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        blk = sup.stats(sync=False)["supervisor"]
+        if blk["worker_alive"] and blk["restarts"] >= 1:
+            break
+        time.sleep(0.1)
+    sup.install_snapshot(res.state, res.streaming, version=vc["v"] + 1,
+                         train_step=res.step)
+    time.sleep(0.5)                 # a post-restart tail gets served
+    drv2.stop()
+    drv2.join(timeout=120)
+    results = drv2.results()
+    st = sup.stats(sync=True)
+    blk = st["supervisor"]
+    sup.close()
+    _STEADY_RECOMPILES += int(st.get("steady_state_recompiles") or 0)
+
+    oop = pct(results)
+    rids = sorted(r_.rid for r_ in results)
+    unavailable = [r_ for r_ in results if isinstance(r_, Unavailable)]
+
+    def r(x, nd=3):
+        return None if x is None else round(x, nd)
+
+    return {
+        "inproc_p50_ms": inproc["p50_ms"],
+        "inproc_p95_ms": inproc["p95_ms"],
+        "inproc_p99_ms": inproc["p99_ms"],
+        "inproc_served": inproc["served"],
+        "oop_p50_ms": oop["p50_ms"],
+        "oop_p95_ms": oop["p95_ms"],
+        "oop_p99_ms": oop["p99_ms"],
+        "oop_served": oop["served"],
+        "joint_train_samples_per_sec": round(train_sps, 1),
+        "shm_publish_p95_ms": r(blk.get("shm_publish_p95_ms")),
+        "shm_region_bytes": blk.get("shm_region_bytes"),
+        "worker_start_s": round(start_s, 2),
+        "restart_to_first_served_ms": r(
+            blk.get("restart_to_first_served_ms"), 1),
+        "restarts": blk.get("restarts"),
+        "crashes": blk.get("crashes"),
+        "budget_ok": int(not blk.get("restart_budget_exhausted")),
+        "unavailable": len(unavailable),
+        "conserved": int(rids == list(range(len(rids)))),
+        "freshness_p95_s": r(st.get("freshness_p95_s"), 6),
+        "steady_state_recompiles": int(
+            st.get("steady_state_recompiles") or 0),
+        "qps": qps, "die_at": die_at, "train_steps": steps,
     }
 
 
@@ -2043,6 +2225,13 @@ def main():
         out["online"] = online
         out["online_train_samples_per_sec"] = online[
             "train_samples_per_sec"]
+    isolated = _guard("isolated_serving", run_isolated_serving)
+    if isolated is not None:
+        # the process boundary priced against the in-process floor, plus
+        # crash-containment stats from a real mid-stream worker kill;
+        # compare_bench's check_isolated_serving gates restart/budget/
+        # conservation and the boundary-overhead multiple
+        out["isolated_serving"] = isolated
     obsplane = _guard("obs_plane", run_obs_plane)
     if obsplane is not None:
         # what the observability plane itself charges (sketch-backed

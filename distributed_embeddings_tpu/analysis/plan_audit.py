@@ -352,6 +352,14 @@ class RankBudget:
     # in-flight clone), one frozen opt-shaped slab shared across
     # versions, and two streaming-state copies — 0 for offline plans
     snapshot_bytes: int = 0
+    # the process-isolated serving transport (parallel/supervisor.py):
+    # the double-buffered seqlock shared-memory region the trainer maps
+    # to publish snapshots to out-of-process workers (utils/shm.py).
+    # HOST RAM on the trainer host, not HBM — reported but excluded
+    # from total_bytes / hbm_frac and the HBM contract. Rank-uniform
+    # (the pickled payload carries the GLOBAL gathered slabs); 0 for
+    # in-process plans.
+    shm_region_bytes: int = 0
 
     def to_json(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -447,6 +455,10 @@ class PlanReport:
             + (f" · online RCU snapshots "
                f"{self.per_rank[0].snapshot_bytes / 1e6:.2f} MB/rank"
                if self.per_rank and self.per_rank[0].snapshot_bytes
+               else "")
+            + (f" · shm serving region "
+               f"{self.per_rank[0].shm_region_bytes / 1e6:.2f} MB host"
+               if self.per_rank and self.per_rank[0].shm_region_bytes
                else ""),
             "",
             "| rank | tables | live GB | alloc GB | opt GB | a2a buf GB "
@@ -583,7 +595,8 @@ def audit_plan(target,
                label: Optional[str] = None,
                contract: Optional[PlanContract] = None,
                streaming_config=None,
-               online: bool = False) -> PlanReport:
+               online: bool = False,
+               isolated: bool = False) -> PlanReport:
     """Price a plan without building it.
 
     Args:
@@ -624,6 +637,16 @@ def audit_plan(target,
         streaming-state copies. An offline-fitting plan can exceed HBM
         the moment serving runs beside training; this prices that
         before building anything.
+      isolated: price the process-isolated serving transport
+        (``parallel/supervisor.py``): bills the double-buffered seqlock
+        shared-memory region as the rank-uniform ``shm_region_bytes``,
+        using ``utils/shm.py``'s exact arithmetic —
+        ``region_bytes(slack_capacity(payload))`` where the payload is
+        the host-pickled GLOBAL snapshot (gathered packed slabs plus
+        streaming leaves, world-wide; workers re-shard on ingest) and
+        the slack is the ``DETPU_SHM_SLACK`` growth headroom. HOST RAM
+        on the trainer host, not HBM: reported, but excluded from
+        ``total_bytes`` / ``hbm_frac`` and the HBM contract.
 
     Nothing executes and nothing is materialized: the heaviest object
     built is the executor's numpy plan tensors (``[world, n]`` per
@@ -718,6 +741,19 @@ def audit_plan(target,
     snap_bytes = (2 * alloc_rank + opt_rank + 2 * stream_bytes
                   if online else 0)
 
+    # the process-isolated serving transport (see the `isolated` arg):
+    # shm.py's exact region arithmetic over the host-pickled GLOBAL
+    # payload — the gathered packed slabs plus streaming leaves across
+    # every rank (the supervisor publishes global state; the worker
+    # re-shards on ingest)
+    shm_bytes = 0
+    if isolated:
+        from ..utils import shm as shm_mod
+
+        payload_len = world * (alloc_rank + stream_bytes)
+        shm_bytes = shm_mod.region_bytes(
+            shm_mod.slack_capacity(payload_len))
+
     spec = CHIP_SPECS[chip]
     per_rank = []
     for r in range(world):
@@ -731,7 +767,8 @@ def audit_plan(target,
             total_bytes=total,
             hbm_frac=total / spec.hbm_bytes,
             streaming_state_bytes=stream_bytes,
-            snapshot_bytes=snap_bytes))
+            snapshot_bytes=snap_bytes,
+            shm_region_bytes=shm_bytes))
 
     slabs = []
     for w in geom.widths:

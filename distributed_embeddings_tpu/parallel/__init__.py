@@ -56,14 +56,20 @@ from .serving import (
     Expired,
     Failed,
     Overloaded,
+    RealtimeDriver,
     Request,
     ServeConfig,
     Served,
     ServingRuntime,
+    Unavailable,
 )
 from .streaming import (
     StreamingConfig,
     init_streaming,
+)
+from .supervisor import (
+    SuperviseConfig,
+    Supervisor,
 )
 from .trainer import (
     HybridTrainState,

@@ -83,7 +83,7 @@ from typing import Any, Callable, Dict, List, Optional
 from ..utils import envvars, mplane, obs
 from ..utils import runtime as runtime_mod
 from .resilient import ResilientResult, _atomic_json, run_resilient
-from .serving import Request, ServeResult, ServingRuntime
+from .serving import RealtimeDriver, Request, ServeResult, ServingRuntime
 from .trainer import HybridTrainState, clone_pytree
 
 logger = logging.getLogger(__name__)
@@ -342,13 +342,21 @@ class OnlineRuntime:
     publication, rollback and serving; and no flush ever runs before
     the first publication, so every response carries a version.
 
-    Serve arrivals are step-paced and deterministic: ``make_request(i)``
-    is submitted ``requests_per_step`` times per train step —
-    multiplied by ``burst_x`` (default ``DETPU_SERVE_BURST_X``) at the
-    ``DETPU_FAULT=burst@<step>`` drill positions — which keeps chaos
-    drills and CRC-identity comparisons reproducible. Real-time load
-    belongs to :func:`~.serving.drive` against a runtime whose
-    snapshots another thread of control publishes.
+    Serve arrivals come in one of two modes. **Step-paced** (default):
+    ``make_request(i)`` is submitted ``requests_per_step`` times per
+    train step — multiplied by ``burst_x`` (default
+    ``DETPU_SERVE_BURST_X``) at the ``DETPU_FAULT=burst@<step>`` drill
+    positions — which keeps chaos drills and CRC-identity comparisons
+    reproducible. **Real-time** (``realtime_qps=...``): a
+    :class:`~.serving.RealtimeDriver` on its own thread of control
+    submits and polls an open-loop Poisson-free arrival schedule
+    against the live publisher while training runs, so
+    ``freshness_p95_s`` measures WALL-CLOCK staleness under true
+    concurrency instead of step-paced pumping. In real-time mode the
+    pump only publishes (the driver owns submit/poll), the driver
+    starts after the first publication + ladder warmup (every response
+    carries a version; the steady baseline predates traffic), and
+    burst drill positions are seconds of stream, not step ordinals.
 
     Training never blocks on serving: the pump is strictly post-step
     host work, publication is a bounded device copy, and when it still
@@ -371,6 +379,8 @@ class OnlineRuntime:
             warmup_template=None,
             make_request: Optional[Callable[[int], Request]] = None,
             requests_per_step: int = 0,
+            realtime_qps: Optional[float] = None,
+            realtime_drain_s: float = 30.0,
             burst_x: Optional[float] = None,
             resume: bool = True,
             on_step: Optional[Callable] = None,
@@ -385,11 +395,27 @@ class OnlineRuntime:
         ``emb_optimizer``, ``dense_tx``, ``streaming_state``,
         ``checkpoint_every_steps``, ...); ``checkpoint_dir`` and
         ``resume`` come from this runtime so the publisher sidecar and
-        the checkpoint agree on lineage."""
+        the checkpoint agree on lineage.
+
+        ``realtime_qps`` switches serve load to the wall-clock open
+        loop (see the class docstring); it is mutually exclusive with
+        ``requests_per_step`` and requires ``make_request``. The driver
+        is stopped and drained (up to ``realtime_drain_s``) after
+        training returns, and its typed responses land in
+        ``serve_results`` alongside any shed submissions."""
         if "checkpoint_dir" in resilient_kwargs:
             raise ValueError(
                 "pass checkpoint_dir to OnlineRuntime(...), not run() — "
                 "the publisher sidecar must share the checkpoint lineage")
+        if realtime_qps is not None:
+            if requests_per_step:
+                raise ValueError(
+                    "pick ONE load mode: step-paced requests_per_step "
+                    "or wall-clock realtime_qps, not both")
+            if make_request is None:
+                raise ValueError("realtime_qps requires make_request")
+            if realtime_qps <= 0:
+                raise ValueError("realtime_qps must be positive")
         self.publisher = SnapshotPublisher(
             self.serving, config=self.config,
             sidecar_path=self.sidecar_path, resume=resume,
@@ -399,6 +425,7 @@ class OnlineRuntime:
               else envvars.get_float("DETPU_SERVE_BURST_X"))
         results: List[ServeResult] = []
         seq = {"i": 0}
+        driver: Dict[str, Optional[RealtimeDriver]] = {"drv": None}
 
         def _pump(cur, loss, metrics, state_now, telem, stream):
             now = self._clock()
@@ -413,7 +440,19 @@ class OnlineRuntime:
                 # the steady-state recompile baseline includes every
                 # one-time compile in the process
                 self.serving.warmup(warmup_template)
-            if make_request is not None and requests_per_step > 0:
+            if realtime_qps is not None:
+                if (driver["drv"] is None
+                        and self.publisher.published is not None):
+                    # first pump: a snapshot exists and the ladder is
+                    # warm — hand the serve plane its own thread of
+                    # control; from here on the pump only publishes
+                    drv = RealtimeDriver(
+                        self.serving, make_request, realtime_qps,
+                        duration_s=None, burst_x=bx,
+                        drain_s=realtime_drain_s, clock=self._clock)
+                    driver["drv"] = drv
+                    drv.start()
+            elif make_request is not None and requests_per_step > 0:
                 n = int(round(requests_per_step
                               * (bx if cur in burst else 1.0)))
                 for _ in range(n):
@@ -422,7 +461,8 @@ class OnlineRuntime:
                     rej = self.serving.submit(req)
                     if rej is not None:
                         results.append(rej)
-            results.extend(self.serving.poll())
+            if realtime_qps is None:
+                results.extend(self.serving.poll())
             if on_step is not None:
                 return on_step(cur, loss, metrics, state_now)
             return None
@@ -436,16 +476,31 @@ class OnlineRuntime:
         if self.checkpoint_dir is not None:
             warm_checkpoint_io(de, state,
                                resilient_kwargs.get("streaming_state"))
-        train = run_resilient(
-            step_fn, state, data, de=de,
-            checkpoint_dir=self.checkpoint_dir, resume=resume,
-            on_step_aux=_pump, **resilient_kwargs)
+        try:
+            train = run_resilient(
+                step_fn, state, data, de=de,
+                checkpoint_dir=self.checkpoint_dir, resume=resume,
+                on_step_aux=_pump, **resilient_kwargs)
+        except BaseException:
+            drv = driver["drv"]
+            if drv is not None:
+                drv.stop()
+                drv.join(timeout=realtime_drain_s + 5.0)
+            raise
         if not train.preempted:
             # final publish + drain: the freshest completed state serves
             # the tail (and the bench's served-AUC tracks the offline
             # final model)
             if self.publisher._last_step != train.step:
                 self.publisher.publish(train.state, train.streaming)
+        drv = driver["drv"]
+        if drv is not None:
+            # stop AFTER the final publish so the driver's drain serves
+            # the tail from the freshest completed state
+            drv.stop()
+            drv.join(timeout=realtime_drain_s + 5.0)
+            results.extend(drv.results())
+        if not train.preempted and realtime_qps is None:
             results.extend(self.serving.flush())
         return OnlineResult(
             train=train, serve_results=results,

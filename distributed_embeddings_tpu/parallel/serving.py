@@ -79,6 +79,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
 import time
 from typing import (Any, Callable, Dict, List, Optional, Sequence, Tuple)
 
@@ -280,6 +281,25 @@ class Failed(ServeResult):
     recorded as a ``serve_flush_error`` event."""
 
     reason: str = ""
+
+
+@dataclasses.dataclass
+class Unavailable(ServeResult):
+    """The serving WORKER is down: it crashed or hung and its
+    supervisor is restarting it (or has exhausted the restart budget).
+    One rung below ``stale_snapshot`` on the degradation ladder — a
+    stale server still answers, a dead one answers TYPED: every request
+    arriving during the outage (and every request that was in flight
+    inside the dead worker) gets this instead of being lost or hanging
+    a caller forever. ``outage_s`` is how long the worker had been down
+    when this request arrived; ``restarts`` how many supervised
+    restarts have been spent. Emitted only by the trainer-side
+    ``parallel.supervisor.Supervisor`` — the in-process runtime cannot
+    be "down" while it runs."""
+
+    reason: str = "worker_down"
+    outage_s: float = 0.0
+    restarts: int = 0
 
 
 # ----------------------------------------------------------- the runtime
@@ -1132,6 +1152,122 @@ def synthetic_request(rng: np.random.Generator, table_sizes: Sequence[int],
     return Request(cats=cats, batch=batch, priority=priority)
 
 
+class RealtimeDriver:
+    """Wall-clock open-loop load driver on its OWN thread of control.
+
+    The process-isolation layer (ISSUE 18) needs serving load that is
+    concurrent with the trainer — not step-paced pumping interleaved
+    with train steps — so that ``freshness_p95_s`` measures TRUE
+    wall-clock staleness: the driver thread submits and polls in real
+    time while the trainer thread publishes snapshots whenever ITS loop
+    gets there. Works against anything with the ``submit``/``poll``
+    surface: the in-process :class:`ServingRuntime` or the trainer-side
+    ``parallel.supervisor.Supervisor`` proxy for an out-of-process
+    worker.
+
+    Arrival generation matches :func:`drive` (fixed ``qps``; whole
+    seconds named in ``burst_positions`` multiply the rate by
+    ``burst_x``; open-loop, so a slow backend piles real pressure onto
+    the admission controller instead of stalling the generator).
+    ``duration_s=None`` runs until :meth:`stop` — the supervised-outage
+    drill kills and restarts the worker mid-stream and needs load that
+    simply keeps arriving.
+
+    Usage::
+
+        drv = RealtimeDriver(rt, make_request, qps=200, duration_s=2.0)
+        drv.start()
+        ...                      # trainer keeps training + publishing
+        drv.join()               # waits for the stream + drain
+        results = drv.results()
+    """
+
+    def __init__(self, server, make_request: Callable[[int], Request],
+                 qps: float, *, duration_s: Optional[float] = None,
+                 burst_positions: Optional[Sequence[int]] = None,
+                 burst_x: Optional[float] = None, drain_s: float = 10.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if burst_positions is None:
+            burst_positions = runtime_mod.burst_steps()
+        if burst_x is None:
+            burst_x = envvars.get_float("DETPU_SERVE_BURST_X")
+        self._server = server
+        self._make_request = make_request
+        self._qps = float(qps)
+        self._duration_s = duration_s
+        self._burst = set(int(p) for p in burst_positions)
+        self._burst_x = float(burst_x)
+        self._drain_s = float(drain_s)
+        self._clock = clock
+        self._results: List[ServeResult] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.submitted = 0
+
+    # ------------------------------------------------------------ control
+
+    def start(self) -> "RealtimeDriver":
+        if self._thread is not None:
+            raise RuntimeError("driver already started")
+        self._thread = threading.Thread(
+            target=self._run, name="detpu-serve-driver", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop generating arrivals; the loop still drains the queue
+        (in-flight requests get real answers, not silence)."""
+        self._stop.set()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is None:
+            raise RuntimeError("driver never started")
+        self._thread.join(timeout)
+
+    def results(self) -> List[ServeResult]:
+        """Everything collected so far (the full stream after
+        :meth:`join`); safe to call from any thread."""
+        with self._lock:
+            return list(self._results)
+
+    # --------------------------------------------------------- the loop
+
+    def _collect(self, out: Sequence[ServeResult]) -> None:
+        if out:
+            with self._lock:
+                self._results.extend(out)
+
+    def _run(self) -> None:
+        start = self._clock()
+        next_t, i = 0.0, 0
+        while not self._stop.is_set() and (
+                self._duration_s is None or next_t < self._duration_s):
+            now = self._clock() - start
+            while next_t <= now and (
+                    self._duration_s is None or next_t < self._duration_s):
+                rej = self._server.submit(self._make_request(i))
+                if rej is not None:
+                    self._collect([rej])
+                i += 1
+                rate = self._qps * (self._burst_x
+                                    if int(next_t) in self._burst else 1.0)
+                next_t += 1.0 / rate
+                if self._stop.is_set():
+                    break
+            self._collect(self._server.poll())
+            wait = next_t - (self._clock() - start)
+            if wait > 0:
+                time.sleep(min(0.0005, wait))  # poll tick, 0.5 ms cap
+        self.submitted = i
+        deadline = self._clock() + self._drain_s
+        while (getattr(self._server, "queued_samples", 0)
+               and self._clock() < deadline):
+            self._collect(self._server.poll())
+            time.sleep(0.0005)
+        self._collect(self._server.poll())
+
+
 def drive(rt: ServingRuntime, make_request: Callable[[int], Request],
           qps: float, duration_s: float, *,
           burst_positions: Optional[Sequence[int]] = None,
@@ -1148,41 +1284,14 @@ def drive(rt: ServingRuntime, make_request: Callable[[int], Request],
     deterministic per position: the same positions always spike, only
     wall-clock jitter differs run to run.
 
-    The loop is OPEN-LOOP: every arrival whose time has passed is
-    submitted before the next poll, however long the previous flush
-    took — a slow backend therefore piles real pressure onto the
-    runtime's queue (where the admission controller must bound it)
-    instead of silently stalling the generator (which would make any
-    overload unmeasurable)."""
-    if burst_positions is None:
-        burst_positions = runtime_mod.burst_steps()
-    burst = set(int(p) for p in burst_positions)
-    if burst_x is None:
-        burst_x = envvars.get_float("DETPU_SERVE_BURST_X")
-    arrivals: List[float] = []
-    t = 0.0
-    while t < duration_s:
-        rate = qps * (burst_x if int(t) in burst else 1.0)
-        arrivals.append(t)
-        t += 1.0 / rate
-    results: List[ServeResult] = []
-    start = rt._clock()
-    i = 0
-    while i < len(arrivals):
-        now = rt._clock() - start
-        while i < len(arrivals) and arrivals[i] <= now:
-            rej = rt.submit(make_request(i))
-            if rej is not None:
-                results.append(rej)
-            i += 1
-        results.extend(rt.poll())
-        if i < len(arrivals):
-            wait = arrivals[i] - (rt._clock() - start)
-            if wait > 0:
-                time.sleep(min(0.0005, wait))  # poll tick, 0.5 ms cap
-    deadline = rt._clock() + drain_s
-    while rt.queued_samples and rt._clock() < deadline:
-        results.extend(rt.poll())
-        time.sleep(0.0005)
-    results.extend(rt.poll())
-    return results
+    Since ISSUE 18 this is a thin synchronous wrapper over
+    :class:`RealtimeDriver` — ONE arrival/poll loop serves both the
+    blocking tools and the concurrent train-while-serve drills — so the
+    load runs on the driver's own thread even here (the calling thread
+    just waits)."""
+    drv = RealtimeDriver(rt, make_request, qps, duration_s=duration_s,
+                         burst_positions=burst_positions, burst_x=burst_x,
+                         drain_s=drain_s, clock=rt._clock)
+    drv.start()
+    drv.join()
+    return drv.results()

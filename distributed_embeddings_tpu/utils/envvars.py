@@ -280,6 +280,47 @@ declare("DETPU_ONLINE_PUBLISH_STEPS", default="1",
             "(rollback-and-replay republishes immediately, whatever the "
             "cadence)")
 
+# process-isolated serving: shared-memory snapshot transport + the
+# serving-worker supervisor (utils/shm.py + parallel/supervisor.py +
+# tools/check_isolation.py = make check-isolation)
+declare("DETPU_SHM_READ_RETRIES", default="8",
+        doc="seqlock read attempts per SnapshotShm.read_latest() call: a "
+            "reader that keeps catching the writer mid-publish (sequence "
+            "stamps disagree or the CRC32 fails) retries this many times, "
+            "then returns None and keeps serving its previous snapshot — "
+            "a torn cross-process read is impossible by construction, "
+            "only a missed refresh")
+declare("DETPU_SHM_SLACK", default="1.25",
+        doc="sizing multiplier for the shared-memory snapshot region: "
+            "each of the two seqlock buffers holds slack * the template "
+            "payload's serialized bytes (pickle framing varies a little "
+            "run to run; shapes/dtypes never do). A later payload that "
+            "exceeds the buffer raises — the region is sized once, "
+            "before the worker attaches")
+declare("DETPU_SUPERVISE_BACKOFF_BASE_S", default="0.1",
+        doc="base delay of the supervisor's jittered exponential backoff "
+            "between serving-worker restart attempts (the runtime.retry "
+            "idiom: doubles per attempt, jittered in [0.5x, 1.5x))")
+declare("DETPU_SUPERVISE_BACKOFF_MAX_S", default="2",
+        doc="cap on the supervisor's restart backoff delay (seconds)")
+declare("DETPU_SUPERVISE_DEADLINE_S", default="5",
+        doc="heartbeat deadline: a serving worker whose last pong is "
+            "older than this is declared HUNG, killed (SIGKILL — hang "
+            "detection never depends on the child cooperating) and "
+            "restarted under the restart budget")
+declare("DETPU_SUPERVISE_HEARTBEAT_S", default="0.25",
+        doc="interval between supervisor heartbeat pings to the serving "
+            "worker; pongs carry the worker's live stats subset")
+declare("DETPU_SUPERVISE_MAX_RESTARTS", default="3",
+        doc="restart budget per Supervisor lifetime: after this many "
+            "worker deaths the supervisor stays down (every request "
+            "answers typed Unavailable) instead of crash-looping — "
+            "training is never taken down with it")
+declare("DETPU_SUPERVISE_START_TIMEOUT_S", default="300",
+        doc="deadline for a (re)started serving worker to finish its "
+            "warmup and report ready; a worker that blows it is treated "
+            "as crashed (kill + backoff + next attempt)")
+
 # non-finite guard (utils/obs.py + parallel/trainer.py + resilient.py)
 declare("DETPU_NANGUARD", default="1",
         doc="on-device non-finite guard in the hybrid step; 0 = build the "
@@ -342,7 +383,14 @@ declare("DETPU_FAULT", default="",
             "(a traffic spike of never-seen ids while serving, make "
             "check-online); in the online runtime burst@ positions are "
             "train-step ordinals (requests-per-step multiply by "
-            "DETPU_SERVE_BURST_X at those steps)")
+            "DETPU_SERVE_BURST_X at those steps). die@<pos> / hang@<pos> "
+            "target a SUPERVISED serving worker (parallel/supervisor.py): "
+            "at that arrival ordinal the worker hard-exits (die@, the "
+            "SIGKILL/OOM equivalent) or stops answering (hang@, the "
+            "wedged-process equivalent) — the supervisor must detect "
+            "either, answer in-flight requests typed Unavailable, dump "
+            "the black box on the child's behalf, and restart within its "
+            "budget (make check-isolation)")
 declare("DETPU_ON_MISMATCH", default="reshard",
         doc="resilient-driver restore policy when a checkpoint's recorded "
             "sharding plan/world size differs from the model's: 'reshard' "

@@ -53,6 +53,7 @@ latency, and a CRC-intact black box after an injected NaN escalation.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import math
@@ -79,6 +80,9 @@ DEFAULT_RELATIVE_ACCURACY = 0.01
 #: Values at or below this observe into the dedicated zero bucket (the
 #: log mapping needs a positive floor); latencies in ms sit far above.
 MIN_TRACKABLE = 1e-9
+
+# stand-in second lock for self-merge (merge(sk, sk) must not re-acquire)
+_NULL_CTX = contextlib.nullcontext()
 
 _LabelKey = Tuple[Tuple[str, str], ...]
 
@@ -130,10 +134,18 @@ class QuantileSketch:
     :meth:`merge` adds bucket counts — associative and commutative by
     construction, so per-rank / per-process sketches fold into one
     fleet-wide view in any order with no accuracy loss.
+
+    Thread-safe: a per-sketch lock covers every mutation and every read
+    of the bucket dict, so a runtime thread can :meth:`observe` while
+    the HTTP exporter's daemon thread renders quantiles — the
+    concurrent-scrape case the real-time serving driver creates (a bare
+    dict here throws ``dictionary changed size during iteration`` under
+    that interleaving, or silently tears ``count``/``sum``).
     """
 
     __slots__ = ("relative_accuracy", "_gamma", "_log_gamma", "buckets",
-                 "zero_count", "count", "sum", "min", "max", "max_buckets")
+                 "zero_count", "count", "sum", "min", "max", "max_buckets",
+                 "_lock")
 
     def __init__(self, relative_accuracy: float = DEFAULT_RELATIVE_ACCURACY,
                  max_buckets: int = 4096):
@@ -149,25 +161,27 @@ class QuantileSketch:
         self.sum = 0.0
         self.min = math.inf
         self.max = -math.inf
+        self._lock = threading.Lock()
 
     def observe(self, value: float) -> None:
         """Fold one sample in (O(1); negative values clamp to the zero
         bucket — every signal here is a latency/depth/age, never below
         zero by construction)."""
         v = float(value)
-        self.count += 1
-        self.sum += v
-        if v < self.min:
-            self.min = v
-        if v > self.max:
-            self.max = v
-        if v <= MIN_TRACKABLE:
-            self.zero_count += 1
-            return
-        i = math.ceil(math.log(v) / self._log_gamma)
-        self.buckets[i] = self.buckets.get(i, 0) + 1
-        if len(self.buckets) > self.max_buckets:
-            self._collapse()
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            if v < self.min:
+                self.min = v
+            if v > self.max:
+                self.max = v
+            if v <= MIN_TRACKABLE:
+                self.zero_count += 1
+                return
+            i = math.ceil(math.log(v) / self._log_gamma)
+            self.buckets[i] = self.buckets.get(i, 0) + 1
+            if len(self.buckets) > self.max_buckets:
+                self._collapse()
 
     def _collapse(self) -> None:
         # DDSketch collapse: fold the LOWEST buckets together so the
@@ -184,22 +198,24 @@ class QuantileSketch:
     def quantile(self, q: float) -> Optional[float]:
         """Value at quantile ``q`` in [0, 1] (within the relative-error
         guarantee), ``None`` when empty."""
-        if self.count == 0:
-            return None
         if not (0.0 <= q <= 1.0):
             raise ValueError(f"quantile {q} outside [0, 1]")
-        rank = q * (self.count - 1)
-        seen = self.zero_count
-        if rank < seen:
-            return 0.0
-        for i in sorted(self.buckets):
-            seen += self.buckets[i]
+        with self._lock:
+            if self.count == 0:
+                return None
+            rank = q * (self.count - 1)
+            seen = self.zero_count
             if rank < seen:
-                # bucket (gamma^(i-1), gamma^i]: the log-midpoint keeps
-                # |reported - true| <= a * true for anything inside
-                mid = 2.0 * self._gamma ** i / (self._gamma + 1.0)
-                return min(mid, self.max)
-        return self.max if self.max > -math.inf else None
+                return 0.0
+            for i in sorted(self.buckets):
+                seen += self.buckets[i]
+                if rank < seen:
+                    # bucket (gamma^(i-1), gamma^i]: the log-midpoint
+                    # keeps |reported - true| <= a * true for anything
+                    # inside
+                    mid = 2.0 * self._gamma ** i / (self._gamma + 1.0)
+                    return min(mid, self.max)
+            return self.max if self.max > -math.inf else None
 
     @property
     def mean(self) -> Optional[float]:
@@ -213,25 +229,32 @@ class QuantileSketch:
             raise ValueError(
                 f"cannot merge sketches of different accuracy "
                 f"({self.relative_accuracy} vs {other.relative_accuracy})")
-        for i, n in other.buckets.items():
-            self.buckets[i] = self.buckets.get(i, 0) + n
-        self.zero_count += other.zero_count
-        self.count += other.count
-        self.sum += other.sum
-        self.min = min(self.min, other.min)
-        self.max = max(self.max, other.max)
-        if len(self.buckets) > self.max_buckets:
-            self._collapse()
+        # both locks, in id order, so two threads merging opposite
+        # directions can't deadlock
+        first, second = ((self, other) if id(self) <= id(other)
+                         else (other, self))
+        with first._lock:
+            with second._lock if first is not second else _NULL_CTX:
+                for i, n in other.buckets.items():
+                    self.buckets[i] = self.buckets.get(i, 0) + n
+                self.zero_count += other.zero_count
+                self.count += other.count
+                self.sum += other.sum
+                self.min = min(self.min, other.min)
+                self.max = max(self.max, other.max)
+                if len(self.buckets) > self.max_buckets:
+                    self._collapse()
         return self
 
     def to_dict(self) -> Dict[str, Any]:
         """JSON-portable form (cross-process merge / file export)."""
-        return {"relative_accuracy": self.relative_accuracy,
-                "buckets": {str(i): n for i, n in self.buckets.items()},
-                "zero_count": self.zero_count, "count": self.count,
-                "sum": self.sum,
-                "min": self.min if self.count else None,
-                "max": self.max if self.count else None}
+        with self._lock:
+            return {"relative_accuracy": self.relative_accuracy,
+                    "buckets": {str(i): n for i, n in self.buckets.items()},
+                    "zero_count": self.zero_count, "count": self.count,
+                    "sum": self.sum,
+                    "min": self.min if self.count else None,
+                    "max": self.max if self.count else None}
 
     @classmethod
     def from_dict(cls, doc: Dict[str, Any]) -> "QuantileSketch":
@@ -250,7 +273,13 @@ class QuantileSketch:
 
 
 class _Family:
-    """One named metric family: children keyed by their label set."""
+    """One named metric family: children keyed by their label set.
+
+    ``_children`` is guarded by a per-family lock: the runtime thread
+    creates children (first observation of a new label set) while the
+    exporter's daemon thread sorts them for a scrape — unguarded, that
+    interleaving dies with ``dictionary changed size during iteration``.
+    """
 
     kind = "untyped"
 
@@ -258,20 +287,23 @@ class _Family:
         self.name = name
         self.help = help_text
         self._children: Dict[_LabelKey, Any] = {}
+        self._lock = threading.Lock()
 
     def child(self, **labels: str):
         key = _label_key(labels)
-        c = self._children.get(key)
-        if c is None:
-            c = self._new_child()
-            self._children[key] = c
-        return c
+        with self._lock:
+            c = self._children.get(key)
+            if c is None:
+                c = self._new_child()
+                self._children[key] = c
+            return c
 
     def _new_child(self):
         raise NotImplementedError
 
     def items(self) -> Iterable[Tuple[_LabelKey, Any]]:
-        return sorted(self._children.items())
+        with self._lock:
+            return sorted(self._children.items())
 
 
 class _Value:

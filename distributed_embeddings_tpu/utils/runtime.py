@@ -141,11 +141,12 @@ def _fault_specs() -> List[Tuple[str, str, Optional[str]]]:
         if not item:
             continue
         if (item.startswith(("preempt@", "nan@", "badbatch@", "oovflood@",
-                             "burst@"))
+                             "burst@", "die@", "hang@"))
                 or item == "corrupt@ckpt"):
             continue  # driver/checkpoint-level drills: see preempt_step(),
             # nan_steps(), badbatch_steps(), oovflood_steps(),
-            # burst_steps() and corrupt_ckpt_requested()
+            # burst_steps(), die_steps(), hang_steps() and
+            # corrupt_ckpt_requested()
         parts = item.split(":", 2)
         if len(parts) < 2:
             logger.warning("ignoring malformed %s entry %r", FAULT_ENV, item)
@@ -237,6 +238,33 @@ def burst_steps() -> Tuple[int, ...]:
     decides WHEN the spike hits; the stream contents stay the seeded
     Zipfian draw), parsed per call like the other fault specs."""
     return _at_steps("burst")
+
+
+def die_steps() -> Tuple[int, ...]:
+    """Positions of ``DETPU_FAULT=die@<pos>`` drills: at each of those
+    positions of a supervised serving worker's request stream (GLOBAL
+    ordinals — the supervisor's request counter, monotone across
+    restarts, so each position fires at most once and a drill kill is
+    followed by clean recovery, not a crash loop) the worker hard-exits
+    (``os._exit``, no cleanup handlers — the SIGKILL/OOM-kill
+    equivalent). The trainer-side :class:`~..parallel.supervisor
+    .Supervisor` must detect the death, answer every in-flight request
+    with a typed ``Unavailable``, dump the crash black box on the
+    child's behalf, and restart the worker under its backoff budget —
+    the crash-containment drill ``make check-isolation`` runs. Parsed
+    per call like the other fault specs."""
+    return _at_steps("die")
+
+
+def hang_steps() -> Tuple[int, ...]:
+    """Positions of ``DETPU_FAULT=hang@<pos>`` drills: at each of those
+    positions of a supervised serving worker's request stream the worker
+    stops answering (a long sleep on its control loop — the wedged-
+    process equivalent of ``die@``). Heartbeats stop, the supervisor's
+    deadline trips, and the worker is killed and restarted exactly like
+    a crash — hang detection must never depend on the child
+    cooperating. Parsed per call like the other fault specs."""
+    return _at_steps("hang")
 
 
 def corrupt_ckpt_requested() -> bool:

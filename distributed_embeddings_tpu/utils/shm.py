@@ -1,0 +1,282 @@
+"""Double-buffered shared-memory snapshot transport with a seqlock.
+
+The process-isolation layer (ISSUE 18) moves serving out of the trainer
+process, so snapshot publication crosses a process boundary: the trainer
+serializes each published ``(params, streaming_state, version,
+train_step, wall_ts)`` and the serving worker picks it up with NO
+syscall round-trip per read and NO lock shared with the trainer — a
+crashed or wedged worker must never be able to block publication (the
+revenue path must not gate the state path), and a mid-write reader must
+never see a torn snapshot.
+
+The classic answer is a seqlock over a double buffer, and that is
+exactly what this module is — pure stdlib, no jax, importable from any
+process:
+
+* the region is ``HEADER + 2 x (BUFHDR + capacity)``;
+* the single writer publishes sequence ``s`` into buffer ``s % 2`` —
+  readers only ever look at buffer ``latest % 2``, so a reader can only
+  race the writer if the writer LAPS it (publishes twice during one
+  read);
+* each buffer carries ``seq_begin`` / ``seq_end`` stamps (written
+  before / after the payload) plus a CRC32 over the canonical payload
+  bytes and metadata, so a lapped read is detected by stamp mismatch or
+  checksum failure and retried;
+* after :data:`READ_RETRIES_ENV` failed attempts :meth:`read_latest`
+  returns ``None`` — the caller KEEPS its previous snapshot (bounded
+  staleness beats a torn read, the same policy the in-process RCU path
+  pins in ``parallel/online.py``).
+
+CPython gives no memory fences, but the protocol does not need them:
+the stamps narrow the race window and the CRC is the actual integrity
+guarantee — any interleaving that slips past the stamps fails the
+checksum and retries. ``tests/test_shm.py`` pins torn-read detection by
+corrupting the region between stamp writes.
+
+Ownership: the trainer :meth:`SnapshotShm.create`\\ s (and later
+``unlink``\\ s) the region; workers :meth:`SnapshotShm.attach` by name.
+Attach explicitly UNREGISTERS the segment from the attaching process's
+``multiprocessing.resource_tracker``: on Python < 3.13 an attacher's
+tracker believes it owns every segment it has seen and unlinks them all
+when that process dies — which would let a SIGKILLed serving worker
+destroy the very region the supervisor needs to restart it (the exact
+drill ``make check-isolation`` runs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import struct
+import zlib
+from multiprocessing import resource_tracker, shared_memory
+from typing import Optional
+
+from . import envvars
+
+READ_RETRIES_ENV = "DETPU_SHM_READ_RETRIES"
+SLACK_ENV = "DETPU_SHM_SLACK"
+
+# region magic: "DEsn" — refuse to read a region we did not lay out
+MAGIC = 0x4445736E
+
+# header: magic u32 | capacity u64 | latest published sequence u64
+# (latest == 0 means nothing has ever been published)
+_HEADER = struct.Struct("<IQQ")
+# per-buffer header: seq_begin u64 | seq_end u64 | crc u32 | length u64
+#                    | version u64 | train_step u64 | wall_ts f64
+_BUFHDR = struct.Struct("<QQIQQQd")
+# the metadata the CRC covers alongside the payload bytes
+_META = struct.Struct("<QQQQd")
+
+HEADER_SIZE = _HEADER.size
+BUFHDR_SIZE = _BUFHDR.size
+
+
+def region_bytes(capacity: int) -> int:
+    """Total shared-memory footprint for a payload ``capacity`` — what
+    ``plan_audit`` bills into the rank budget (two buffers: the one
+    being served and the one being written)."""
+    return HEADER_SIZE + 2 * (BUFHDR_SIZE + int(capacity))
+
+
+def slack_capacity(payload_len: int) -> int:
+    """Buffer capacity for an observed payload size, padded by
+    :data:`SLACK_ENV` — streaming tables grow between publishes (new
+    rows admitted), so the region is sized off the FIRST payload with
+    headroom rather than resized (resizing would break every attached
+    reader)."""
+    slack = envvars.get_float(SLACK_ENV)
+    if slack < 1.0:
+        raise ValueError(f"{SLACK_ENV} must be >= 1.0, got {slack}")
+    return int(math.ceil(int(payload_len) * slack))
+
+
+@dataclasses.dataclass(frozen=True)
+class ShmSnapshot:
+    """One bitwise-consistent read: the serialized payload plus the
+    metadata stamped with it (all covered by the CRC that admitted
+    this read)."""
+
+    payload: bytes
+    seq: int
+    version: int
+    train_step: int
+    wall_ts: float
+
+
+def _crc(payload: bytes, seq: int, version: int, train_step: int,
+         wall_ts: float) -> int:
+    meta = _META.pack(seq, len(payload), version, train_step, wall_ts)
+    return zlib.crc32(payload, zlib.crc32(meta)) & 0xFFFFFFFF
+
+
+class SnapshotShm:
+    """The transport: one writer (the trainer-side publisher), any
+    number of readers (serving workers, including reborn ones)."""
+
+    def __init__(self, shm: shared_memory.SharedMemory, capacity: int,
+                 *, owner: bool):
+        self._shm = shm
+        self._capacity = int(capacity)
+        self._owner = owner
+        self._closed = False
+        # the writer's in-memory cursor; re-derived from the header so a
+        # writer re-attach (tests, crash-resume) keeps seqs monotone
+        self._seq = self._latest()
+
+    # ------------------------------------------------------ construction
+
+    @classmethod
+    def create(cls, capacity: int, name: Optional[str] = None
+               ) -> "SnapshotShm":
+        """Create (and own) a region able to carry payloads up to
+        ``capacity`` bytes."""
+        capacity = int(capacity)
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        shm = shared_memory.SharedMemory(
+            create=True, size=region_bytes(capacity), name=name)
+        _HEADER.pack_into(shm.buf, 0, MAGIC, capacity, 0)
+        # zero both buffer headers so a reader racing creation sees
+        # seq_begin == seq_end == 0 and reports "nothing published"
+        for idx in (0, 1):
+            off = HEADER_SIZE + idx * (BUFHDR_SIZE + capacity)
+            _BUFHDR.pack_into(shm.buf, off, 0, 0, 0, 0, 0, 0, 0.0)
+        return cls(shm, capacity, owner=True)
+
+    @classmethod
+    def attach(cls, name: str) -> "SnapshotShm":
+        """Attach to an existing region by name (reader side)."""
+        shm = shared_memory.SharedMemory(name=name)
+        magic, capacity, _ = _HEADER.unpack_from(shm.buf, 0)
+        if magic != MAGIC:
+            shm.close()
+            raise ValueError(
+                f"shared memory region {name!r} is not a snapshot region "
+                f"(magic 0x{magic:08X} != 0x{MAGIC:08X})")
+        try:
+            # see module docstring: the attacher must NOT let its
+            # resource tracker unlink a region it does not own
+            resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
+        except Exception:  # noqa: BLE001 - tracker layout is stdlib-private;
+            # failing to unregister only risks a spurious unlink warning
+            pass
+        return cls(shm, capacity, owner=False)
+
+    # --------------------------------------------------------- accessors
+
+    @property
+    def name(self) -> str:
+        return self._shm.name
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    @property
+    def size(self) -> int:
+        return region_bytes(self._capacity)
+
+    def _latest(self) -> int:
+        _, _, latest = _HEADER.unpack_from(self._shm.buf, 0)
+        return latest
+
+    def latest_seq(self) -> int:
+        """Sequence number of the most recently published snapshot
+        (0 when nothing has been published yet)."""
+        return self._latest()
+
+    def _buf_off(self, seq: int) -> int:
+        return HEADER_SIZE + (seq % 2) * (BUFHDR_SIZE + self._capacity)
+
+    # ----------------------------------------------------------- writing
+
+    def publish_bytes(self, payload: bytes, *, version: int,
+                      train_step: int, wall_ts: float) -> int:
+        """Publish one serialized snapshot; returns its sequence number.
+
+        Seqlock write order: stamp ``seq_begin`` (poisoning in-progress
+        reads of this buffer), copy payload + metadata + CRC, stamp
+        ``seq_end``, then flip the header's ``latest`` — a reader either
+        sees the old sequence (old buffer, untouched) or the new one
+        (fully written)."""
+        n = len(payload)
+        if n > self._capacity:
+            raise ValueError(
+                f"snapshot payload of {n} bytes exceeds the region "
+                f"capacity of {self._capacity}; size the region with "
+                f"slack_capacity() off the largest expected payload "
+                f"(raise {SLACK_ENV} if streaming growth outpaced it)")
+        seq = self._seq + 1
+        off = self._buf_off(seq)
+        buf = self._shm.buf
+        crc = _crc(payload, seq, int(version), int(train_step),
+                   float(wall_ts))
+        # begin stamp first (seq_end still stale -> mismatch -> retry)
+        _BUFHDR.pack_into(buf, off, seq, 0, crc, n, int(version),
+                          int(train_step), float(wall_ts))
+        data_off = off + BUFHDR_SIZE
+        buf[data_off:data_off + n] = payload
+        # end stamp validates the buffer ...
+        struct.pack_into("<Q", buf, off + 8, seq)
+        # ... and only then does the region advertise it
+        _HEADER.pack_into(buf, 0, MAGIC, self._capacity, seq)
+        self._seq = seq
+        return seq
+
+    # ----------------------------------------------------------- reading
+
+    def read_latest(self, *, retries: Optional[int] = None
+                    ) -> Optional[ShmSnapshot]:
+        """One consistent snapshot, or ``None`` (nothing published yet,
+        or the writer lapped us ``retries`` times — keep the previous
+        snapshot and try again later)."""
+        if retries is None:
+            retries = envvars.get_int(READ_RETRIES_ENV)
+        buf = self._shm.buf
+        for _ in range(max(1, retries)):
+            seq = self._latest()
+            if seq == 0:
+                return None
+            off = self._buf_off(seq)
+            (seq_begin, seq_end, crc, n, version, train_step,
+             wall_ts) = _BUFHDR.unpack_from(buf, off)
+            if seq_begin != seq or seq_end != seq or n > self._capacity:
+                continue  # mid-write or lapped: retry against `latest`
+            data_off = off + BUFHDR_SIZE
+            payload = bytes(buf[data_off:data_off + n])
+            if _crc(payload, seq, version, train_step, wall_ts) != crc:
+                continue  # torn copy slipped past the stamps
+            return ShmSnapshot(payload=payload, seq=seq, version=version,
+                               train_step=train_step, wall_ts=wall_ts)
+        return None
+
+    # ---------------------------------------------------------- lifetime
+
+    def close(self) -> None:
+        """Detach this process's mapping (the region lives on)."""
+        if not self._closed:
+            self._closed = True
+            self._shm.close()
+
+    def unlink(self) -> None:
+        """Destroy the region (owner only; after every reader is done
+        with it — a supervisor tears this down last)."""
+        self.close()
+        if self._owner:
+            try:
+                # a SAME-process attach (tests) unregistered this name;
+                # re-register (set-add, idempotent) so SharedMemory
+                # .unlink()'s own unregister finds it instead of
+                # spraying KeyError noise in the tracker daemon
+                resource_tracker.register(self._shm._name, "shared_memory")  # noqa: SLF001
+            except Exception:  # noqa: BLE001 - cosmetic only
+                pass
+            self._shm.unlink()
+
+    def __enter__(self) -> "SnapshotShm":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -17,7 +17,7 @@ from tools import detlint
 from tools.detlint.rules import (bare_except, donated_aux, eager_backend,
                                  env_registry, hardcoded_capacity,
                                  host_fetch, module_scope_jax, named_scope,
-                                 unsized_unique)
+                                 spawn_context, unsized_unique)
 
 CTX = {"repo": detlint.REPO}
 PARALLEL = "distributed_embeddings_tpu/parallel/x.py"
@@ -200,11 +200,61 @@ def test_donated_aux_clean_twins():
         assert not _check(donated_aux, ok), ok
 
 
+def test_spawn_context_rule():
+    """Seeded drill: default-context multiprocessing in package code
+    fires; the spawn idiom, process-free submodules, and the spawn-ok
+    waiver stay quiet."""
+    # raw-module factories = default (fork) context
+    assert _check(spawn_context,
+                  "import multiprocessing\n"
+                  "p = multiprocessing.Process(target=f)\n")
+    assert _check(spawn_context,
+                  "import multiprocessing as mp\n"
+                  "pool = mp.Pool(4)\n")
+    # importing the factory binds the default context at the import
+    assert _check(spawn_context, "from multiprocessing import Process\n")
+    assert _check(spawn_context, "from multiprocessing.pool import Pool\n")
+    # asking for fork (or the platform default) by name
+    assert _check(spawn_context,
+                  "import multiprocessing\n"
+                  'ctx = multiprocessing.get_context("fork")\n')
+    assert _check(spawn_context,
+                  "import multiprocessing\n"
+                  "ctx = multiprocessing.get_context()\n")
+    assert _check(spawn_context,
+                  "from multiprocessing import set_start_method\n"
+                  'set_start_method("forkserver")\n')
+    # the blessed idiom: explicit spawn, factories off the spawn context
+    ok = ("import multiprocessing\n"
+          '_SPAWN = multiprocessing.get_context("spawn")\n'
+          "p = _SPAWN.Process(target=f)\n")
+    assert not _check(spawn_context, ok)
+    assert not _check(spawn_context,
+                      "from multiprocessing import get_context\n"
+                      'ctx = get_context(method="spawn")\n')
+    # process-free corners start nothing
+    assert not _check(spawn_context,
+                      "from multiprocessing import shared_memory\n"
+                      "from multiprocessing.connection import Client\n"
+                      "from multiprocessing import resource_tracker\n")
+    # the waiver
+    assert not _check(spawn_context,
+                      "import multiprocessing\n"
+                      "p = multiprocessing.Process(target=f)"
+                      "  # spawn-ok: no jax in this process\n")
+    # out of scope (scoping is the runner's job): tests may fork freely
+    assert not detlint._matches("tests/test_shm.py", spawn_context.SCOPE)
+    assert detlint._matches(
+        "distributed_embeddings_tpu/parallel/supervisor.py",
+        spawn_context.SCOPE)
+
+
 def test_discover_rules_finds_all():
     rules = detlint.discover_rules()
     assert {"bare-except", "eager-backend", "env-registry",
             "hardcoded-capacity", "host-fetch", "module-scope-jax",
-            "named-scope-exchange", "unsized-unique"} <= set(rules)
+            "named-scope-exchange", "spawn-context",
+            "unsized-unique"} <= set(rules)
 
 
 def test_unknown_rule_name_raises():

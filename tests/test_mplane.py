@@ -12,6 +12,7 @@ bounded rings, atomic CRC-stamped dump, tamper detection."""
 import json
 import math
 import os
+import threading
 import urllib.request
 
 import numpy as np
@@ -251,6 +252,89 @@ def test_sync_counters_and_step_metrics_adapters():
     # the mirror is idempotent (set_total, not inc): re-sync != double
     mplane.sync_counters(reg, {"served": 11})
     assert 'detpu_events_total{event="served"} 11' in reg.render()
+
+
+def test_concurrent_observe_while_scrape():
+    """The race the process-isolated serving driver hits: runtime
+    threads observe (mutating sketch buckets AND creating labelled
+    children) while the exporter's daemon thread renders. Pre-lock this
+    died with ``dictionary changed size during iteration``; post-lock
+    every observation must also still be accounted for (none torn)."""
+    reg = MetricsRegistry()
+    fam = reg.sketch("detpu_race_ms", "observe-while-scrape drill")
+    writers, per_writer = 4, 1500
+    errors = []
+    stop = threading.Event()
+
+    def writer(tid):
+        try:
+            rng = np.random.default_rng(tid)
+            for i in range(per_writer):
+                # rotating label sets force child creation mid-scrape
+                fam.observe(float(rng.exponential(5.0)),
+                            stage=f"s{tid}", shard=str(i % 7))
+        except Exception as e:  # noqa: BLE001 - the assertion surface
+            errors.append(e)
+
+    def scraper():
+        try:
+            while not stop.is_set():
+                reg.render()
+                reg.to_dict()
+        except Exception as e:  # noqa: BLE001 - the assertion surface
+            errors.append(e)
+
+    scrape = threading.Thread(target=scraper)
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(writers)]
+    scrape.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    scrape.join()
+    assert errors == []
+    assert sum(sk.count for _, sk in fam.items()) == writers * per_writer
+
+
+def test_concurrent_observe_while_quantile_under_collapse():
+    """Sketch-level: a tiny ``max_buckets`` forces :meth:`_collapse`
+    (bucket-dict pops) to interleave with ``quantile`` iteration — the
+    tightest version of the torn-read window."""
+    sk = QuantileSketch(max_buckets=8)
+    errors = []
+    done = threading.Event()
+
+    def writer(seed):
+        try:
+            rng = np.random.default_rng(seed)
+            for _ in range(4000):
+                sk.observe(float(rng.lognormal(mean=2.0, sigma=3.0)))
+        except Exception as e:  # noqa: BLE001 - the assertion surface
+            errors.append(e)
+
+    def reader():
+        try:
+            while not done.is_set():
+                sk.quantile(0.99)
+                sk.to_dict()
+        except Exception as e:  # noqa: BLE001 - the assertion surface
+            errors.append(e)
+
+    r = threading.Thread(target=reader)
+    ws = [threading.Thread(target=writer, args=(s,)) for s in range(2)]
+    r.start()
+    for w in ws:
+        w.start()
+    for w in ws:
+        w.join()
+    done.set()
+    r.join()
+    assert errors == []
+    assert sk.count == 2 * 4000
+    assert len(sk.buckets) <= 8
+    assert sk.quantile(0.5) is not None
 
 
 # ---------------------------------------------------- the scrape endpoint

@@ -243,6 +243,36 @@ def test_online_snapshot_bills_streaming_state_twice():
                                  + 2 * o0.streaming_state_bytes)
 
 
+def test_isolated_serving_bills_shm_region():
+    """``isolated=True`` prices the supervisor's double-buffered shm
+    transport (utils/shm.py's exact region arithmetic over the global
+    host-pickled payload) — host RAM, reported per rank but never
+    counted against the HBM contract."""
+    from distributed_embeddings_tpu.utils import shm
+
+    cfgs = [{"input_dim": 4096 + 256, "output_dim": 16,
+             "streaming": {"capacity": 4096, "buckets": 256}},
+            {"input_dim": 1000, "output_dim": 16}]
+    st = DistEmbeddingStrategy(cfgs, 2)
+
+    class _S:  # duck-typed StreamingConfig
+        depth, buckets = 3, 512
+
+    off = pa.audit_plan(st, 16, streaming_config=_S)
+    iso = pa.audit_plan(st, 16, streaming_config=_S, isolated=True)
+    o0, r0 = off.per_rank[0], iso.per_rank[0]
+    assert o0.shm_region_bytes == 0
+    payload = 2 * (o0.alloc_param_bytes + o0.streaming_state_bytes)
+    assert r0.shm_region_bytes == shm.region_bytes(
+        shm.slack_capacity(payload))
+    assert r0.shm_region_bytes > 2 * payload  # 2 buffers + slack + headers
+    # host RAM, not HBM: totals and the contract are untouched
+    assert r0.total_bytes == o0.total_bytes
+    assert r0.hbm_frac == o0.hbm_frac
+    assert "shm serving region" in iso.markdown()
+    assert "shm serving region" not in off.markdown()
+
+
 def test_seeded_past_cliff_slab_fails_naming_slab():
     """Criteo-1TB bf16 on 16 ranks WITHOUT column slicing stacks the
     ~40M-row tables into a ~9.5 GB apply slab — past the measured

@@ -56,6 +56,33 @@ def test_fault_point_modes(monkeypatch):
     assert time.monotonic() - t0 >= 0.05
 
 
+def test_die_and_hang_at_parsing(monkeypatch):
+    """die@/hang@ positional drills parse like the other @-style specs,
+    combine with them, and never leak into the mode:point spec list (a
+    die@5 must not warn as a malformed die:<point> entry)."""
+    assert runtime.die_steps() == ()
+    assert runtime.hang_steps() == ()
+    monkeypatch.setenv(runtime.FAULT_ENV, "die@5")
+    assert runtime.die_steps() == (5,)
+    monkeypatch.setenv(runtime.FAULT_ENV, "hang@3,hang@9")
+    assert runtime.hang_steps() == (3, 9)
+    monkeypatch.setenv(runtime.FAULT_ENV,
+                       "oovflood@2,die@4,burst@1,hang@7,corrupt@ckpt")
+    assert runtime.die_steps() == (4,)
+    assert runtime.hang_steps() == (7,)
+    assert runtime.oovflood_steps() == (2,)
+    assert runtime.burst_steps() == (1,)
+    assert runtime._fault_specs() == []  # all skipped, none malformed
+    # malformed positions warn and drop (like nan@/burst@)
+    monkeypatch.setenv(runtime.FAULT_ENV, "die@notanint,die@2")
+    assert runtime.die_steps() == (2,)
+    # the mode:point grammar is untouched: hang:point still parses as a
+    # fault_point spec, not a positional drill
+    monkeypatch.setenv(runtime.FAULT_ENV, "hang:backend:60,hang@4")
+    assert runtime._fault_specs() == [("hang", "backend", "60")]
+    assert runtime.hang_steps() == (4,)
+
+
 def test_retry_succeeds_after_transient_failures():
     calls = {"n": 0}
 

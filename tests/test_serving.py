@@ -566,6 +566,55 @@ def test_drive_applies_burst_positions():
     assert rt.stats()["steady_state_recompiles"] == 0
 
 
+def test_realtime_driver_concurrent_with_publisher():
+    """ISSUE 18: the wall-clock driver runs on its OWN thread while the
+    'trainer' (this thread) keeps publishing snapshots — freshness_p95_s
+    must come out of true concurrency, every request must come back
+    typed (none lost, none hung), and the publish/flush race must never
+    produce a torn read (the RCU contract under an actual second
+    thread)."""
+    import time as _time
+
+    de, state, rt, clock = _build(max_batch=32, max_queue=4096,
+                                  deadline_ms=60_000, max_wait_ms=2)
+    rt._clock = _time.monotonic   # the driver runs in real time
+    rt.warmup(_tmpl())
+    rt.install_snapshot(state, version=1, train_step=0)
+    rng = np.random.default_rng(11)
+    drv = sv.RealtimeDriver(rt, lambda i: _req(rng, n=1), qps=300,
+                            duration_s=None, burst_positions=(),
+                            drain_s=30.0)
+    drv.start()
+    t0, v = _time.monotonic(), 1
+    while _time.monotonic() - t0 < 0.6:
+        v += 1
+        rt.install_snapshot(state, version=v, train_step=v)
+        rt.note_train_step(v)
+        _time.sleep(0.02)
+    drv.stop()
+    drv.join(timeout=60)
+    results = drv.results()
+    assert drv.submitted > 0
+    # conservation across threads: every submitted rid answered once
+    assert sorted(r.rid for r in results) == list(range(drv.submitted))
+    served = [r for r in results if isinstance(r, sv.Served)]
+    assert served and {r.version for r in served} != {1}  # saw republishes
+    st = rt.stats()
+    assert st["freshness_p95_s"] is not None
+    assert st["freshness_p95_s"] >= 0.0
+    assert st["steady_state_recompiles"] == 0
+
+
+def test_unavailable_is_typed_and_ranked_below_stale():
+    """The outage response: carries its provenance, renders a status
+    like every other typed result, and is NOT a Served."""
+    u = sv.Unavailable(rid=7, latency_ms=0.0, reason="worker_down",
+                       outage_s=1.5, restarts=2)
+    assert u.status == "unavailable"
+    assert not isinstance(u, sv.Served)
+    assert (u.reason, u.outage_s, u.restarts) == ("worker_down", 1.5, 2)
+
+
 def test_compare_bench_serving_gate():
     from tools import compare_bench as cb
 

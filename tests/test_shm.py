@@ -1,0 +1,160 @@
+"""Seqlock shared-memory snapshot transport (ISSUE 18 tentpole piece 1).
+
+The contract under test: a reader gets a BITWISE-consistent snapshot or
+``None`` — never a torn one. Torn-read detection is pinned by forging
+exactly the states a racing writer produces (begin stamp without end
+stamp; payload bytes changed after the CRC was computed) and asserting
+the reader refuses them, then recovers on the next clean publish. The
+cross-process pin against in-process ``install_snapshot`` lives in
+``tests/test_supervisor.py`` (needs jax); this file is pure transport.
+"""
+
+import struct
+
+import pytest
+
+from distributed_embeddings_tpu.utils import shm
+
+
+def _mk(capacity=4096):
+    region = shm.SnapshotShm.create(capacity)
+    return region
+
+
+def test_roundtrip_payload_and_metadata():
+    with _mk() as region:
+        payload = b"\x00\x01snapshot-bytes\xff" * 7
+        seq = region.publish_bytes(payload, version=3, train_step=12,
+                                   wall_ts=123.5)
+        assert seq == 1
+        snap = region.read_latest()
+        assert snap is not None
+        assert snap.payload == payload
+        assert (snap.seq, snap.version, snap.train_step, snap.wall_ts) == \
+            (1, 3, 12, 123.5)
+        region.unlink()
+
+
+def test_read_before_any_publish_is_none():
+    with _mk() as region:
+        assert region.read_latest() is None
+        assert region.latest_seq() == 0
+        region.unlink()
+
+
+def test_latest_wins_and_buffers_alternate():
+    with _mk() as region:
+        for v in range(1, 6):
+            region.publish_bytes(f"snap-{v}".encode(), version=v,
+                                 train_step=v * 2, wall_ts=float(v))
+        snap = region.read_latest()
+        assert snap.payload == b"snap-5"
+        assert snap.version == 5 and snap.seq == 5
+        region.unlink()
+
+
+def test_attach_reads_what_create_published():
+    region = _mk()
+    try:
+        region.publish_bytes(b"cross-handle", version=9, train_step=1,
+                             wall_ts=0.25)
+        reader = shm.SnapshotShm.attach(region.name)
+        try:
+            snap = reader.read_latest()
+            assert snap is not None and snap.payload == b"cross-handle"
+            assert reader.capacity == region.capacity
+        finally:
+            reader.close()
+    finally:
+        region.unlink()
+
+
+def test_attach_rejects_foreign_region():
+    from multiprocessing import shared_memory
+
+    raw = shared_memory.SharedMemory(create=True, size=256)
+    try:
+        with pytest.raises(ValueError, match="not a snapshot region"):
+            shm.SnapshotShm.attach(raw.name)
+    finally:
+        raw.close()
+        raw.unlink()
+
+
+def test_mid_write_stamps_refuse_the_read():
+    """Forge the writer-mid-publish state: begin stamp advanced, end
+    stamp stale. Every retry re-reads ``latest`` and must give up with
+    ``None`` — the caller keeps its previous snapshot."""
+    with _mk() as region:
+        region.publish_bytes(b"good", version=1, train_step=1, wall_ts=1.0)
+        off = region._buf_off(1)
+        # seq_begin := 99 while seq_end stays 1 -> mismatch
+        struct.pack_into("<Q", region._shm.buf, off, 99)
+        assert region.read_latest(retries=4) is None
+        region.unlink()
+
+
+def test_crc_catches_payload_torn_after_stamps():
+    """Both stamps valid but a payload byte changed after the CRC was
+    computed — the interleaving stamps alone cannot see."""
+    with _mk() as region:
+        region.publish_bytes(b"consistent-bytes", version=1, train_step=1,
+                             wall_ts=1.0)
+        data_off = region._buf_off(1) + shm.BUFHDR_SIZE
+        region._shm.buf[data_off] ^= 0xFF
+        assert region.read_latest(retries=4) is None
+        region.unlink()
+
+
+def test_recovery_after_fresh_publish():
+    """A corrupted buffer is left behind the moment the writer publishes
+    again: the new sequence lands in the OTHER buffer and reads clean."""
+    with _mk() as region:
+        region.publish_bytes(b"old", version=1, train_step=1, wall_ts=1.0)
+        data_off = region._buf_off(1) + shm.BUFHDR_SIZE
+        region._shm.buf[data_off] ^= 0xFF
+        assert region.read_latest(retries=2) is None
+        region.publish_bytes(b"new", version=2, train_step=2, wall_ts=2.0)
+        snap = region.read_latest()
+        assert snap is not None and snap.payload == b"new"
+        assert snap.version == 2
+        region.unlink()
+
+
+def test_oversized_payload_raises_with_sizing_hint():
+    with _mk(capacity=64) as region:
+        with pytest.raises(ValueError, match="slack_capacity"):
+            region.publish_bytes(b"x" * 65, version=1, train_step=1,
+                                 wall_ts=1.0)
+        region.unlink()
+
+
+def test_region_bytes_and_slack_sizing(monkeypatch):
+    assert shm.region_bytes(100) == \
+        shm.HEADER_SIZE + 2 * (shm.BUFHDR_SIZE + 100)
+    assert shm.slack_capacity(1000) == 1250  # default slack 1.25
+    monkeypatch.setenv(shm.SLACK_ENV, "2.0")
+    assert shm.slack_capacity(1000) == 2000
+    monkeypatch.setenv(shm.SLACK_ENV, "0.5")
+    with pytest.raises(ValueError, match="must be >= 1.0"):
+        shm.slack_capacity(1000)
+
+
+def test_writer_seq_monotone_across_reattach():
+    """A writer handle rebuilt over an existing region (crash-resume)
+    continues the sequence instead of restarting at 1 — readers key
+    staleness off monotone seqs."""
+    region = _mk()
+    try:
+        region.publish_bytes(b"a", version=1, train_step=1, wall_ts=1.0)
+        region.publish_bytes(b"b", version=2, train_step=2, wall_ts=2.0)
+        rewriter = shm.SnapshotShm.attach(region.name)
+        try:
+            seq = rewriter.publish_bytes(b"c", version=3, train_step=3,
+                                         wall_ts=3.0)
+            assert seq == 3
+            assert region.read_latest().payload == b"c"
+        finally:
+            rewriter.close()
+    finally:
+        region.unlink()

@@ -1,0 +1,290 @@
+#!/usr/bin/env python
+"""Verify gate for process-isolated serving (run by ``make
+check-isolation`` inside ``make verify``) — the crash-containment drill.
+
+CPU end-to-end, one trainer child on the 8-virtual-device mesh which
+spawns a REAL world-8 serving worker through the supervisor:
+
+1. the child first runs the serving-free reference: the same training
+   stream under ``run_resilient`` with checkpointing, no supervisor at
+   all — its final checkpoint CRCs are the trajectory contract;
+2. then the supervised run: a spawned worker (``DETPU_FAULT=die@<rid>``
+   injected into the WORKER's env only) serves a wall-clock open-loop
+   request stream — with a 4x burst in second 1 — while the trainer
+   trains and publishes snapshots through shared memory. Request
+   ``<rid>`` executes ``os._exit`` inside the worker mid-burst: the
+   supervisor must detect the death, answer every in-flight and
+   outage-window request with typed ``Unavailable`` (zero lost, zero
+   hung futures), dump a CRC-stamped blackbox naming
+   ``serve_worker_crash``, and restart the worker within the backoff
+   budget while training never blocks;
+3. after the restart a fresh tail of normal-rate requests must be
+   served IN FULL from the reborn worker (which re-ingested the latest
+   snapshot from shm before answering) at ZERO steady-state recompiles,
+   request rids must be conserved across the whole drill (every
+   submission answered exactly once, rids contiguous), and the
+   supervised run's final checkpoints must be CRC-IDENTICAL to the
+   serving-free reference — the worker's death never touched training.
+
+Exit 0 when the drill passes; 1 with a readable reason otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WORLD = 8
+STEPS = 24
+QPS = 120.0       # normal arrival rate against the worker
+BURST_AT = 1      # second of the stream the 4x spike hits
+BURST_X = 4.0
+DIE_AT = 150      # global request ordinal that os._exit()s the worker
+
+_CHILD = """
+import sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np, jax, jax.numpy as jnp, optax
+jax.config.update('jax_platforms', 'cpu')
+from distributed_embeddings_tpu.parallel import (
+    RealtimeDriver, Served, SparseSGD, SuperviseConfig, Supervisor,
+    Unavailable, make_hybrid_train_step, run_resilient)
+from distributed_embeddings_tpu.utils import mplane
+from tools import isolation_common as ic
+
+world = {world}
+STEPS = {steps}
+
+def loss_fn(dp, outs, batch):
+    return sum(batch[:, i % 2].mean() * jnp.mean(o)
+               for i, o in enumerate(outs)) * jnp.mean(dp["w"])
+
+def make_batch(i):
+    rng = np.random.default_rng(900 + i)
+    cats = [np.asarray(rng.integers(0, s, 8), np.int32)
+            for s in ic.SIZES]
+    cats.append(np.asarray(rng.integers(i, i + 6, 8) * 7 + 10_000_000,
+                           np.int32))
+    return cats, np.asarray(rng.normal(size=(8, 2)), np.float32)
+
+def data(start):
+    for i in range(start, STEPS):
+        yield make_batch(i)
+
+def train_once(ckpt, pump=None):
+    built = ic.build(world=world)
+    step = make_hybrid_train_step(built["de"], loss_fn, optax.sgd(0.05),
+                                  SparseSGD(), mesh=built["mesh"],
+                                  with_metrics=True, nan_guard=True,
+                                  dynamic=built["scfg"])
+    return built, run_resilient(
+        step, built["state"], data, de=built["de"], checkpoint_dir=ckpt,
+        checkpoint_every_steps=4, resume=True, emb_optimizer=SparseSGD(),
+        dense_tx=optax.sgd(0.05),
+        streaming_state=built["streaming"][1], metrics_interval=0,
+        on_step_aux=pump)
+
+# ---- 1. serving-free reference -------------------------------------
+_, ref = train_once({ref_ckpt!r})
+assert ref.step == STEPS and not ref.preempted
+
+# ---- 2. supervised run: train + publish + serve + crash ------------
+blackbox = {blackbox!r}
+sup = Supervisor(
+    "tools.isolation_common:worker_factory", {{"world": world}},
+    config=SuperviseConfig(
+        blackbox_path=blackbox,
+        env={{"DETPU_FAULT": "die@{die_at}", "DETPU_METRICS_PORT": ""}}))
+sup.start()
+built0 = ic.build(world=world)
+sup.install_snapshot(built0["state"], built0["streaming"][1],
+                     version=1, train_step=0)
+driver = RealtimeDriver(sup, ic.make_request_fn(seed=3), {qps},
+                        duration_s=None, burst_positions={{{burst_at}}},
+                        burst_x={burst_x}, drain_s=60.0)
+driver.start()
+
+vc = {{"v": 1}}
+def pump(cur, loss, metrics, state_now, telem, stream):
+    if cur % 2 == 0:
+        vc["v"] += 1
+        sup.install_snapshot(state_now, stream, version=vc["v"],
+                             train_step=cur)
+    sup.note_train_step(cur)
+
+t_train0 = time.monotonic()
+_, res = train_once({sup_ckpt!r}, pump=pump)
+train_s = time.monotonic() - t_train0
+assert res.step == STEPS and not res.preempted
+
+# training must not have blocked on the worker: wait out the crash +
+# restart AFTER training returned (the driver keeps the stream open)
+deadline = time.monotonic() + 180
+while time.monotonic() < deadline:
+    blk = sup.stats(sync=False)["supervisor"]
+    if blk["worker_alive"] and blk["restarts"] >= 1:
+        break
+    time.sleep(0.2)
+driver.stop()
+driver.join(timeout=120)
+results = driver.results()
+
+# ---- 3. post-restart tail: fully served from the reborn worker -----
+sup.install_snapshot(res.state, res.streaming, version=vc["v"] + 1,
+                     train_step=res.step)
+tail_drv = RealtimeDriver(sup, ic.make_request_fn(seed=4), 60.0,
+                          duration_s=1.0, burst_positions=(),
+                          drain_s=60.0)
+tail_drv.start()
+tail_drv.join(timeout=120)
+tail = tail_drv.results()
+
+st = sup.stats(sync=True)
+blk = st["supervisor"]
+sup.close()
+
+allr = results + tail
+rids = sorted(r.rid for r in allr)
+unavailable = [r for r in allr if isinstance(r, Unavailable)]
+tail_served = sum(1 for r in tail if isinstance(r, Served))
+bb_trigger, bb_crc_ok = "", 0
+try:
+    payload = mplane.verify_blackbox(blackbox)
+    bb_trigger, bb_crc_ok = payload.get("trigger", ""), 1
+except Exception as e:
+    bb_trigger = "ERROR:" + type(e).__name__
+
+print("FINAL",
+      "SUBMITTED", driver.submitted + tail_drv.submitted,
+      "ANSWERED", len(allr),
+      "CONSERVED", int(rids == list(range(len(rids)))),
+      "UNAVAILABLE", len(unavailable),
+      "UNAVAIL_TYPED", int(all(r.status == "unavailable"
+                               and r.reason for r in unavailable)),
+      "CRASHES", blk["crashes"], "RESTARTS", blk["restarts"],
+      "BUDGET_OK", int(not blk["restart_budget_exhausted"]),
+      "ALIVE", int(blk["worker_alive"]),
+      "TAIL_SERVED", tail_served, "TAIL_TOTAL", len(tail),
+      "STEADY", st.get("steady_state_recompiles", -1),
+      "RTFS_MS", round(blk.get("restart_to_first_served_ms") or -1, 1),
+      "TRAIN_S", round(train_s, 2),
+      "BB_CRC", bb_crc_ok, "BB_TRIGGER", bb_trigger,
+      flush=True)
+"""
+
+
+def _final_crcs(ckpt):
+    with open(os.path.join(ckpt, "meta.json"), encoding="utf-8") as f:
+        return json.load(f)["files"]
+
+
+def main() -> int:
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="detpu_isolation_") as td:
+        ref_ckpt = os.path.join(td, "ref")
+        sup_ckpt = os.path.join(td, "sup")
+        blackbox = os.path.join(td, "sup.blackbox.json")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        for k in ("DETPU_FAULT", "DETPU_OBS", "DETPU_TELEMETRY",
+                  "DETPU_METRICS_PORT"):
+            env.pop(k, None)
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={WORLD}")
+        env["DETPU_CKPT_RING"] = "2"
+        code = _CHILD.format(repo=REPO, world=WORLD, steps=STEPS,
+                             qps=QPS, burst_at=BURST_AT, burst_x=BURST_X,
+                             die_at=DIE_AT, ref_ckpt=ref_ckpt,
+                             sup_ckpt=sup_ckpt, blackbox=blackbox)
+        p = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True, timeout=900)
+        if p.returncode != 0:
+            return _fail([f"drill child failed rc={p.returncode}: "
+                          f"{(p.stderr or p.stdout).strip()[-1500:]}"])
+        got = None
+        for line in reversed(p.stdout.strip().splitlines()):
+            if line.startswith("FINAL"):
+                parts = line.split()
+                got = dict(zip(parts[1::2], parts[2::2]))
+                break
+        if got is None:
+            return _fail(["drill child printed no FINAL line: "
+                          f"{p.stdout.strip()[-800:]}"])
+        errors = []
+        if int(got.get("CRASHES", 0)) < 1:
+            errors.append(
+                f"the worker never crashed (die@{DIE_AT} never fired) — "
+                "the drill tested nothing")
+        if int(got.get("RESTARTS", 0)) < 1 or got.get("BUDGET_OK") != "1":
+            errors.append(
+                f"restart failed (restarts={got.get('RESTARTS')}, "
+                f"budget_ok={got.get('BUDGET_OK')}) — the supervisor "
+                "must restart a crashed worker within the backoff budget")
+        if got.get("ALIVE") != "1":
+            errors.append("the worker is not alive at drill end — no "
+                          "recovery from the crash")
+        if got.get("CONSERVED") != "1":
+            errors.append(
+                "request conservation broken: rids are not contiguous — "
+                "a future was lost, duplicated, or left hanging across "
+                "the crash")
+        if int(got.get("UNAVAILABLE", 0)) < 1:
+            errors.append(
+                "no Unavailable responses — either the outage window was "
+                "empty (kill did not land mid-stream) or outage requests "
+                "were silently dropped")
+        if got.get("UNAVAIL_TYPED") != "1":
+            errors.append("an outage response was not a typed "
+                          "Unavailable with a reason")
+        if got.get("TAIL_SERVED") != got.get("TAIL_TOTAL", "-1"):
+            errors.append(
+                f"post-restart tail served {got.get('TAIL_SERVED')}/"
+                f"{got.get('TAIL_TOTAL')} — the reborn worker did not "
+                "resume full service")
+        if got.get("STEADY") != "0":
+            errors.append(
+                f"{got.get('STEADY')} steady-state recompile(s) in the "
+                "reborn worker — the restart retraced the serve ladder")
+        if got.get("BB_CRC") != "1" or got.get("BB_TRIGGER") \
+                != "serve_worker_crash":
+            errors.append(
+                f"blackbox bad (crc_ok={got.get('BB_CRC')}, trigger="
+                f"{got.get('BB_TRIGGER')!r}) — the supervisor must dump "
+                "a CRC-intact post-mortem naming serve_worker_crash on "
+                "behalf of the SIGKILLed child")
+        crcs, ref_crcs = _final_crcs(sup_ckpt), _final_crcs(ref_ckpt)
+        if crcs != ref_crcs:
+            diff = sorted(k for k in set(crcs) | set(ref_crcs)
+                          if crcs.get(k) != ref_crcs.get(k))
+            errors.append(
+                "supervised training diverged from the serving-free "
+                f"reference (checkpoint CRC mismatch in {diff}) — the "
+                "worker's crash leaked into the training trajectory")
+        if errors:
+            return _fail(errors)
+        print(f"check_isolation: OK (die@{DIE_AT} mid-burst: "
+              f"{got['CRASHES']} crash / {got['RESTARTS']} restart within "
+              f"budget, {got['UNAVAILABLE']} outage requests all typed "
+              f"Unavailable, {got['ANSWERED']}/{got['SUBMITTED']} futures "
+              f"conserved, post-restart tail {got['TAIL_SERVED']}/"
+              f"{got['TAIL_TOTAL']} served at 0 steady-state recompiles, "
+              f"restart-to-first-served {got['RTFS_MS']} ms, training "
+              "CRC-identical to the serving-free reference, blackbox "
+              "CRC-intact)")
+        return 0
+
+
+def _fail(errors) -> int:
+    for e in errors:
+        print(f"check_isolation: {e}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -649,6 +649,89 @@ def check_online(old: Dict[str, Any], new: Dict[str, Any]) -> int:
     return failures
 
 
+#: out-of-process serve latency may cost a socket + pickle round-trip
+#: over the in-process floor, but not a structural multiple of it: p99
+#: beyond FACTOR x inproc + SLACK ms means the boundary grew a stall
+#: (lock convoy, nagle, shm retry storm), not just overhead
+ISOLATED_OOP_FACTOR = 5.0
+ISOLATED_OOP_SLACK_MS = 10.0
+#: ceiling on restart-to-first-served — a reborn worker re-ingests the
+#: latest shm snapshot BEFORE answering, so first service after the
+#: ready handshake is bounded host work, not a recompile
+ISOLATED_RESTART_MS = 30_000.0
+
+
+def check_isolated_serving(old: Dict[str, Any],
+                           new: Dict[str, Any]) -> int:
+    """Gate the ``isolated_serving`` section (ISSUE 18): process-isolated
+    serving with crash containment.
+
+    * a record whose worker never crashed+restarted fails — the section
+      EXISTS to measure supervision under a real kill; zero restarts
+      means the drill fizzled;
+    * ``budget_ok`` != 1 fails — the restart exhausted its backoff
+      budget;
+    * ``conserved`` != 1 fails — a request future was lost, duplicated
+      or left hanging across the crash;
+    * nonzero ``steady_state_recompiles`` fails — the reborn worker
+      retraced its serve ladder;
+    * out-of-process p99 beyond :data:`ISOLATED_OOP_FACTOR` x the
+      in-process floor (+ :data:`ISOLATED_OOP_SLACK_MS`) fails — the
+      boundary grew a structural stall;
+    * ``restart_to_first_served_ms`` beyond
+      :data:`ISOLATED_RESTART_MS` fails;
+    * a candidate missing the section while the baseline has it fails.
+    """
+    sec = new.get("isolated_serving")
+    if not isinstance(sec, dict):
+        if isinstance(old.get("isolated_serving"), dict):
+            print("compare_bench: candidate has no 'isolated_serving' "
+                  "section but the baseline does — the process-isolation "
+                  "scenario failed or was dropped", file=sys.stderr)
+            return 1
+        return 0
+    failures = 0
+    if not sec.get("crashes") or not sec.get("restarts"):
+        print(f"compare_bench: isolated_serving recorded crashes="
+              f"{sec.get('crashes')} restarts={sec.get('restarts')} — "
+              "the mid-stream kill never happened or the supervisor "
+              "never restarted the worker", file=sys.stderr)
+        failures += 1
+    if sec.get("budget_ok") != 1:
+        print("compare_bench: isolated_serving restart budget exhausted "
+              "— the worker could not be brought back within the "
+              "backoff budget", file=sys.stderr)
+        failures += 1
+    if sec.get("conserved") != 1:
+        print("compare_bench: isolated_serving request conservation "
+              "broken — a future was lost, duplicated or left hanging "
+              "across the worker crash", file=sys.stderr)
+        failures += 1
+    rc = sec.get("steady_state_recompiles")
+    if isinstance(rc, (int, float)) and rc > 0:
+        print(f"compare_bench: isolated_serving recompiled {int(rc)} "
+              "time(s) at steady state — the reborn worker retraced its "
+              "serve ladder", file=sys.stderr)
+        failures += 1
+    ip, op = sec.get("inproc_p99_ms"), sec.get("oop_p99_ms")
+    if isinstance(ip, (int, float)) and isinstance(op, (int, float)) \
+            and op > ip * ISOLATED_OOP_FACTOR + ISOLATED_OOP_SLACK_MS:
+        print(f"compare_bench: isolated_serving boundary overhead: "
+              f"out-of-process p99 {op:.1f} ms vs in-process floor "
+              f"{ip:.1f} ms — beyond {ISOLATED_OOP_FACTOR:.0f}x + "
+              f"{ISOLATED_OOP_SLACK_MS:.0f} ms, the socket/shm path "
+              "grew a structural stall", file=sys.stderr)
+        failures += 1
+    rtfs = sec.get("restart_to_first_served_ms")
+    if isinstance(rtfs, (int, float)) and rtfs > ISOLATED_RESTART_MS:
+        print(f"compare_bench: isolated_serving restart-to-first-served "
+              f"{rtfs:.0f} ms exceeds {ISOLATED_RESTART_MS:.0f} ms — "
+              "the reborn worker did not resume service promptly",
+              file=sys.stderr)
+        failures += 1
+    return failures
+
+
 #: max tolerated growth of the observability plane's own costs
 #: (stats() wall time, HTTP scrape round-trip, black-box dump). These
 #: are microsecond/millisecond-scale host measurements with real
@@ -737,6 +820,7 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
     steady_failures += check_streaming(old, new)
     steady_failures += check_serving(old, new)
     steady_failures += check_online(old, new)
+    steady_failures += check_isolated_serving(old, new)
     steady_failures += check_obs_plane(old, new)
     regressions = 0
     rows = []
