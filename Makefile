@@ -5,6 +5,7 @@ SHELL := /bin/bash
 
 .PHONY: all native test test-fast bench bench-diff bench-tpu clean pkg \
         verify lint plan-audit audit-step hlo-audit schedule-audit \
+        concurrency-audit \
         check-backend check-obs check-obs-report check-resilience \
         check-reshard check-recovery check-streaming check-serving \
         check-online check-obsplane check-phase-profile check-isolation \
@@ -30,7 +31,8 @@ bench:
 # plus the static gates (detlint rules, the SPMD step auditor, the legacy
 # no-eager-backend shim), the observability gate, and the
 # preemption-recovery drill — run before shipping a round
-verify: lint plan-audit audit-step hlo-audit schedule-audit check-backend \
+verify: lint plan-audit audit-step hlo-audit schedule-audit \
+        concurrency-audit check-backend \
         check-obs check-obs-report check-phase-profile check-resilience \
         check-reshard check-recovery check-streaming check-serving \
         check-online check-obsplane check-isolation
@@ -76,6 +78,19 @@ hlo-audit:
 # schedule (analysis/schedule_audit.py)
 schedule-audit:
 	env JAX_PLATFORMS=cpu python tools/schedule_audit.py --strict
+
+# concurrency auditor: jax-free AST lock-discipline analysis over the
+# serving plane (shared attributes mutated from >=2 threads of control
+# without a dominating lock, lock-acquisition-order cycles, blocking
+# calls under a held lock, ConcurrencyContract drift) PLUS the
+# explicit-state interleaving model checker proving the seqlock
+# torn-read-detection and supervisor rid-monotonicity invariants over
+# the full bounded interleaving space while refuting three seeded
+# mutants (CRC check removed, stamps swapped, heartbeat deadline
+# off-by-one); self-drills seeded Half-1 findings too
+# (analysis/concurrency_audit.py)
+concurrency-audit:
+	env JAX_PLATFORMS=cpu python tools/concurrency_audit.py --strict
 
 # measured phase-time observatory: run timed steps under
 # jax.profiler.trace on the 8-virtual-device CPU mesh, attribute every

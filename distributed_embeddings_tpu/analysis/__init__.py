@@ -40,6 +40,19 @@ the clock (:func:`~.phase_profile.calibrate` drift table), and
 cross-checks the measured vs modeled serialized/overlappable
 classification (:func:`~.phase_profile.check_agreement`) — enforced by
 ``tools/phase_profile.py --strict`` (= ``make phase-profile``).
+
+:mod:`.concurrency_audit` guards the one axis the compiled-step auditors
+never see: HOST-SIDE concurrency. Half one is a jax-free AST
+lock-discipline analysis of the serving plane (threads-of-control
+discovery, shared attributes mutated from two+ threads without a
+dominating lock, the lock-acquisition-order graph with cycle detection,
+blocking calls under a held lock, declarative
+:class:`~.concurrency_audit.ConcurrencyContract` s); half two is an
+explicit-state interleaving model checker that proves the shm seqlock's
+torn-read detection and the supervisor heartbeat's rid monotonicity
+over the full bounded interleaving space while refuting three seeded
+mutants — enforced by ``tools/concurrency_audit.py --strict``
+(= ``make concurrency-audit``).
 """
 
 from .audit import (
@@ -76,6 +89,21 @@ from .plan_audit import (
     compare_with_memory,
     default_contract,
     rank_strategies,
+)
+# .audit also defines an AuditReport, so the concurrency report class is
+# reached via the submodule (concurrency_audit.AuditReport); only the
+# collision-free names are re-exported flat
+from . import concurrency_audit
+from .concurrency_audit import (
+    ConcFinding,
+    ConcurrencyContract,
+    ProofResult,
+    audit_repo,
+    audit_source,
+    prove,
+    refute,
+    seqlock_model,
+    supervisor_model,
 )
 from . import phase_profile
 from .phase_profile import (
@@ -155,4 +183,14 @@ __all__ = [
     "ScheduleReport",
     "baseline_contracts",
     "parse_hlo_module",
+    "concurrency_audit",
+    "ConcFinding",
+    "ConcurrencyContract",
+    "ProofResult",
+    "audit_repo",
+    "audit_source",
+    "prove",
+    "refute",
+    "seqlock_model",
+    "supervisor_model",
 ]

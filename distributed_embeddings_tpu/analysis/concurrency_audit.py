@@ -1,0 +1,1758 @@
+"""Concurrency auditor — lock discipline + interleaving model checking.
+
+The fifth static gate (``make concurrency-audit``). The other four
+gates — detlint, the jaxpr collective census, the HLO pass budgets, the
+schedule DAG — all verify the *jitted step*; none can see the host-side
+control plane that PRs 16-18 grew around it: the ``RealtimeDriver``
+arrival thread, the ``Supervisor``'s monitor/sender/accept threads, the
+lock-free seqlock in ``utils/shm.py`` and the thread-shared ``mplane``
+registry. This module covers exactly that layer, in two halves, both
+jax-free (pure AST + explicit-state search, no backend, no wall time).
+
+Half 1 — lock-discipline analysis (AST)
+---------------------------------------
+:func:`scan_module` discovers the *threads of control* per module
+(``threading.Thread(target=...)`` sites — ``self``-method, nested
+function and lambda targets — ``run()`` overrides of ``Thread``
+subclasses, ``do_*`` handlers of HTTP request handler classes and
+spawn-context ``Process`` entry points), builds per-class attribute
+access maps with the lock context of every site, and
+:func:`audit_modules` reports:
+
+* ``unguarded-shared`` — an attribute mutated without a dominating
+  ``with self._lock:`` while ≥ 2 threads of control access it (or while
+  the class declares it in ``_THREAD_SHARED``);
+* ``lock-order-cycle`` — a cycle (incl. self-loops: two instances of
+  one class) in the global lock-acquisition-order graph, with
+  acquisitions propagated through intra-module calls;
+* ``blocking-under-lock`` — ``time.sleep``, ``Queue.get/put`` without a
+  timeout, ``.join()``/``.wait()`` without a timeout or a subprocess
+  wait executed while a lock is held (direct, or bubbled up through
+  intra-class calls);
+* ``global-unguarded`` — a contract-declared shared module global
+  mutated outside any module-level lock;
+* ``contract-drift`` — the discovered thread inventory disagrees with
+  the module's declared :class:`ConcurrencyContract`, or a
+  ``_THREAD_SHARED`` tuple names an attribute that does not exist.
+
+Deliberate lock-free sites carry line waivers, matching the detlint
+comment conventions::
+
+    self._worker = None   # thread-local-ok: atomic reference swap ...
+    with second._lock:    # lock-order-ok: id-ordered acquisition ...
+    conn.recv()           # blocking-ok: heartbeat-bounded ...
+
+and every concurrent module declares a :class:`ConcurrencyContract`
+(additive, like ``PassBudget``/``PlanContract``): its threads of
+control, the *external* thread roots of its classes (e.g. the online
+runtime drives ``ServingRuntime.submit`` from the realtime-driver
+thread while the trainer thread installs snapshots — invisible to a
+per-class analysis without the declaration), and its shared module
+globals. Drift between declaration and code is itself a finding.
+
+Half 2 — interleaving model checker
+-----------------------------------
+The two hand-rolled synchronization protocols are extracted into small
+explicit-state transition systems and *exhaustively* explored
+(:func:`explore`: BFS over every interleaving, virtual clock, bounded
+depth, no wall time), proving what the chaos drills only spot-check:
+
+* :func:`seqlock_model` — the ``utils/shm.py`` writer/reader at word
+  granularity (header pack → payload words → end-stamp → latest flip
+  vs. read-latest → read-header → copy words → CRC verify). Invariants:
+  every torn or lapped read is *detected* (never returned as data), a
+  buffer that claims completeness (``begin == end``) really holds that
+  publication's complete payload + CRC ("stamp honesty" — what makes
+  the stamp fast-path meaningful), the writer is never blocked by any
+  reader state, and reader retries stay bounded.
+* :func:`supervisor_model` — the heartbeat state machine of
+  ``parallel/supervisor.py`` (alive → missed-deadline → kill+restart →
+  re-ingest) round-based against nondeterministic crash/hang faults.
+  Invariants: request conservation (every rid answered exactly once:
+  served + unavailable == answered), rid monotonicity across restarts,
+  a hang is detected within the declared deadline, snapshot publication
+  is enabled in *every* reachable state (never blocks on a dead
+  worker), the restart budget is respected and a reborn worker's
+  ingested snapshot never regresses.
+
+Three seeded protocol mutants must be *refuted* by the same explorer
+(:data:`MUTANTS`): ``seqlock:no_crc`` (CRC check removed — a lapped
+torn copy is then accepted), ``seqlock:stamps_swapped`` (the end-stamp
+written up-front with the header — the buffer lies about completeness)
+and ``supervisor:deadline_off_by_one`` (hang detection one heartbeat
+late). The CLI self-drills all three plus the Half-1 drill sources
+(:func:`run_drills`), like ``schedule_audit``'s fake-overlap drill.
+"""
+
+from __future__ import annotations
+
+import ast
+import collections
+import dataclasses
+import os
+import posixpath
+from typing import (Any, Callable, Dict, FrozenSet, Iterable, List, Mapping,
+                    Optional, Sequence, Set, Tuple)
+
+__all__ = [
+    "BLOCKING_OK",
+    "ConcFinding",
+    "ConcurrencyContract",
+    "LOCK_ORDER_OK",
+    "MUTANTS",
+    "Model",
+    "ProofResult",
+    "REFERENCE_CONTRACTS",
+    "THREAD_LOCAL_OK",
+    "AuditReport",
+    "audit_modules",
+    "audit_repo",
+    "audit_source",
+    "explore",
+    "package_root",
+    "prove",
+    "refute",
+    "run_drills",
+    "scan_module",
+    "seqlock_model",
+    "supervisor_model",
+]
+
+# ----------------------------------------------------------- waiver idioms
+
+#: waives an ``unguarded-shared``/``global-unguarded`` mutation site
+THREAD_LOCAL_OK = "thread-local-ok:"
+#: waives a lock acquisition's contribution to the order graph
+LOCK_ORDER_OK = "lock-order-ok:"
+#: waives a blocking call site (direct or the call that bubbles one up)
+BLOCKING_OK = "blocking-ok:"
+
+#: constructor names whose instances ARE mutual-exclusion locks — a
+#: ``with self.<attr>:`` over one of these is a guard + a graph node
+LOCK_TYPES = {"Lock", "RLock", "Condition"}
+
+#: constructor names whose instances synchronize internally — mutating
+#: method calls on such attributes are not shared-state findings
+#: (QuantileSketch/MetricsRegistry/FlightRecorder are documented
+#: thread-safe in utils/mplane.py; ``local`` is threading.local)
+SYNCHRONIZED_TYPES = LOCK_TYPES | {
+    "Event", "Semaphore", "BoundedSemaphore", "Barrier",
+    "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+    "QuantileSketch", "MetricsRegistry", "FlightRecorder", "local",
+}
+
+#: method names that mutate their receiver in place
+MUTATORS = {
+    "append", "appendleft", "extend", "insert", "remove", "pop",
+    "popleft", "clear", "update", "setdefault", "add", "discard",
+    "sort", "reverse", "put", "put_nowait",
+}
+
+#: subprocess-module waits (blocking when called without ``timeout=``)
+SUBPROCESS_WAITS = {"run", "call", "check_call", "check_output"}
+
+
+@dataclasses.dataclass(frozen=True)
+class ConcFinding:
+    """One auditor finding, detlint-shaped: ``path:line: [kind] msg``."""
+
+    kind: str
+    path: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.kind}] {self.message}"
+
+
+# ====================================================================
+# Half 1 — AST scanning
+# ====================================================================
+
+
+def _type_name(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _is_self(node: ast.AST) -> bool:
+    return isinstance(node, ast.Name) and node.id == "self"
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+@dataclasses.dataclass
+class Access:
+    attr: str
+    kind: str                  # "write" | "mutate" | "read"
+    line: int
+    locks: FrozenSet[str]
+    unit: str
+    waived: bool
+
+
+@dataclasses.dataclass
+class Acquisition:
+    lock: str
+    line: int
+    held: FrozenSet[str]
+    unit: str
+    waived: bool
+
+
+@dataclasses.dataclass
+class Blocking:
+    desc: str
+    line: int
+    locks: FrozenSet[str]
+    unit: str
+    waived: bool
+
+
+@dataclasses.dataclass
+class CallSite:
+    callee: Tuple[str, str]    # ("self", meth) | ("mod", func)
+    line: int
+    locks: FrozenSet[str]
+    unit: str
+    waived: bool               # BLOCKING_OK on the call line
+
+
+@dataclasses.dataclass
+class Spawn:
+    ident: str                 # canonical thread-of-control id
+    line: int
+    kind: str                  # "thread" | "process" | "handler"
+    entry_unit: Optional[str]  # unit name running on that thread
+
+
+@dataclasses.dataclass
+class UnitScan:
+    """Everything collected from one unit of execution (a method, or a
+    nested function/lambda that runs on its own spawned thread)."""
+
+    name: str
+    accesses: List[Access] = dataclasses.field(default_factory=list)
+    acquisitions: List[Acquisition] = dataclasses.field(default_factory=list)
+    blockings: List[Blocking] = dataclasses.field(default_factory=list)
+    calls: List[CallSite] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ClassScan:
+    name: str                  # qualified (nesting joined with ".")
+    line: int
+    bases: Tuple[str, ...]
+    lock_attrs: Set[str] = dataclasses.field(default_factory=set)
+    #: the subset of lock_attrs that are reentrant (threading.RLock):
+    #: a self-edge on one of these is re-acquisition, not deadlock
+    rlock_attrs: Set[str] = dataclasses.field(default_factory=set)
+    sync_attrs: Set[str] = dataclasses.field(default_factory=set)
+    thread_shared: Optional[Tuple[str, ...]] = None
+    thread_shared_line: int = 0
+    units: Dict[str, UnitScan] = dataclasses.field(default_factory=dict)
+    #: unit name -> canonical root id (thread entries, handlers)
+    entries: Dict[str, str] = dataclasses.field(default_factory=dict)
+    spawns: List[Spawn] = dataclasses.field(default_factory=list)
+    #: method name -> unit names (properties/setters share a name)
+    by_name: Dict[str, List[str]] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ModuleScan:
+    path: str
+    lines: List[str]
+    classes: List[ClassScan] = dataclasses.field(default_factory=list)
+    #: module-level function units, keyed by function name
+    funcs: Dict[str, UnitScan] = dataclasses.field(default_factory=dict)
+    module_locks: Set[str] = dataclasses.field(default_factory=set)
+    spawns: List[Spawn] = dataclasses.field(default_factory=list)
+    #: watched module globals -> mutation Access list
+    global_accesses: List[Access] = dataclasses.field(default_factory=list)
+
+
+_STMT_BLOCKS = ("body", "orelse", "finalbody")
+
+
+class _Scanner:
+    """Scans one unit of execution with a lexical lock-context stack."""
+
+    def __init__(self, mscan: ModuleScan, cls: Optional[ClassScan],
+                 unit: UnitScan, module_funcs: Set[str],
+                 class_methods: Set[str], skip_nodes: Set[ast.AST],
+                 watch_globals: Set[str]):
+        self.m = mscan
+        self.cls = cls
+        self.unit = unit
+        self.module_funcs = module_funcs
+        self.class_methods = class_methods
+        self.skip = skip_nodes
+        self.watch = watch_globals
+
+    # ------------------------------------------------------------ helpers
+
+    def _marked(self, line: int, marker: str) -> bool:
+        idx = line - 1
+        return 0 <= idx < len(self.m.lines) and marker in self.m.lines[idx]
+
+    def _lock_id(self, expr: ast.expr) -> Optional[str]:
+        """Resolve a ``with`` context expression to a lock identity.
+
+        ``self.<attr>`` (or ``<anything>.<attr>`` when ``attr`` is a
+        known lock attribute of the *current* class — the id-ordered
+        two-instance idiom) maps to ``Class.attr``; a bare module-level
+        lock name maps to ``module:name``. Everything else (files,
+        sockets, tempdirs) is not a lock."""
+        if isinstance(expr, ast.IfExp):
+            # `second._lock if first is not second else _NULL_CTX`:
+            # conservatively treat a conditional acquisition as
+            # acquiring whichever branch resolves to a lock
+            return (self._lock_id(expr.body)
+                    or self._lock_id(expr.orelse))
+        if isinstance(expr, ast.Attribute):
+            if self.cls is not None and expr.attr in self.cls.lock_attrs:
+                return f"{self.cls.name}.{expr.attr}"
+            return None
+        if isinstance(expr, ast.Name) and expr.id in self.m.module_locks:
+            return f"{posixpath.basename(self.m.path)}:{expr.id}"
+        return None
+
+    # ------------------------------------------------------- entry points
+
+    def scan_function(self, fnode: ast.AST) -> None:
+        if isinstance(fnode, ast.Lambda):
+            self._expr(fnode.body, frozenset())
+        else:
+            self._block(fnode.body, frozenset())
+
+    def scan_bodies(self, nodes: Iterable[ast.AST]) -> None:
+        for n in nodes:
+            self.scan_function(n)
+
+    # ------------------------------------------------------ statement walk
+
+    def _block(self, stmts: Sequence[ast.stmt],
+               locks: FrozenSet[str]) -> None:
+        for node in stmts:
+            if isinstance(node, ast.With):
+                self._with(node, locks)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node not in self.skip:
+                    # a closure defined here runs later, possibly
+                    # without the current locks: scan it lock-free
+                    self._block(node.body, frozenset())
+            elif isinstance(node, ast.ClassDef):
+                continue            # nested classes scanned separately
+            else:
+                self._stmt_exprs(node, locks)
+                for field in _STMT_BLOCKS:
+                    sub = getattr(node, field, None)
+                    if sub:
+                        self._block(sub, locks)
+                for h in getattr(node, "handlers", []) or []:
+                    self._block(h.body, locks)
+                for c in getattr(node, "cases", []) or []:
+                    self._block(c.body, locks)
+
+    def _with(self, node: ast.With, locks: FrozenSet[str]) -> None:
+        new = locks
+        for item in node.items:
+            self._expr(item.context_expr, new)
+            lid = self._lock_id(item.context_expr)
+            if lid is not None:
+                line = item.context_expr.lineno
+                self.unit.acquisitions.append(Acquisition(
+                    lock=lid, line=line, held=new, unit=self.unit.name,
+                    waived=self._marked(line, LOCK_ORDER_OK)))
+                new = new | {lid}
+        self._block(node.body, new)
+
+    def _stmt_exprs(self, stmt: ast.stmt, locks: FrozenSet[str]) -> None:
+        for name, value in ast.iter_fields(stmt):
+            if name in _STMT_BLOCKS or name in ("handlers", "cases"):
+                continue
+            vals = value if isinstance(value, list) else [value]
+            for v in vals:
+                if isinstance(v, ast.expr):
+                    self._expr(v, locks)
+
+    # ----------------------------------------------------- expression walk
+
+    def _expr(self, expr: ast.expr, locks: FrozenSet[str]) -> None:
+        for node in self._walk(expr):
+            if isinstance(node, ast.Attribute) and _is_self(node.value):
+                if isinstance(node.ctx, ast.Store):
+                    self._access(node.attr, "write", node.lineno, locks)
+                elif isinstance(node.ctx, ast.Del):
+                    self._access(node.attr, "mutate", node.lineno, locks)
+                else:
+                    self._access(node.attr, "read", node.lineno, locks)
+            elif isinstance(node, ast.Subscript) and isinstance(
+                    node.ctx, (ast.Store, ast.Del)):
+                tgt = node.value
+                if isinstance(tgt, ast.Attribute) and _is_self(tgt.value):
+                    self._access(tgt.attr, "mutate", node.lineno, locks)
+                elif (isinstance(tgt, ast.Name) and self.cls is None
+                      and tgt.id in self.watch):
+                    self._global(tgt.id, node.lineno, locks)
+            elif isinstance(node, ast.Name) and isinstance(
+                    node.ctx, ast.Store):
+                if self.cls is None and node.id in self.watch:
+                    self._global(node.id, node.lineno, locks)
+            elif isinstance(node, ast.Call):
+                self._call(node, locks)
+
+    def _walk(self, expr: ast.expr) -> Iterable[ast.AST]:
+        """``ast.walk`` pruned of nested function/lambda bodies that are
+        scanned as their own units (thread entries)."""
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if node in self.skip:
+                continue
+            if isinstance(node, ast.Lambda) and node is not expr:
+                # inline lambdas (sort keys etc.) run in-place: fold
+                stack.append(node.body)
+                continue
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    # ----------------------------------------------------------- recording
+
+    def _access(self, attr: str, kind: str, line: int,
+                locks: FrozenSet[str]) -> None:
+        if self.cls is None:
+            return
+        self.unit.accesses.append(Access(
+            attr=attr, kind=kind, line=line, locks=locks,
+            unit=self.unit.name,
+            waived=self._marked(line, THREAD_LOCAL_OK)))
+
+    def _global(self, name: str, line: int, locks: FrozenSet[str]) -> None:
+        self.m.global_accesses.append(Access(
+            attr=name, kind="mutate", line=line, locks=locks,
+            unit=self.unit.name,
+            waived=self._marked(line, THREAD_LOCAL_OK)))
+
+    def _call(self, call: ast.Call, locks: FrozenSet[str]) -> None:
+        f = call.func
+        # in-place mutators: self.X.append(...) / watched_global.update(..)
+        if isinstance(f, ast.Attribute) and f.attr in MUTATORS:
+            recv = f.value
+            if isinstance(recv, ast.Attribute) and _is_self(recv.value):
+                self._access(recv.attr, "mutate", call.lineno, locks)
+            elif (isinstance(recv, ast.Name) and self.cls is None
+                  and recv.id in self.watch):
+                self._global(recv.id, call.lineno, locks)
+        # intra-module call edges (lock/blocking propagation)
+        if (isinstance(f, ast.Attribute) and _is_self(f.value)
+                and f.attr in self.class_methods):
+            self.unit.calls.append(CallSite(
+                callee=("self", f.attr), line=call.lineno, locks=locks,
+                unit=self.unit.name,
+                waived=self._marked(call.lineno, BLOCKING_OK)))
+        elif isinstance(f, ast.Name) and f.id in self.module_funcs:
+            self.unit.calls.append(CallSite(
+                callee=("mod", f.id), line=call.lineno, locks=locks,
+                unit=self.unit.name,
+                waived=self._marked(call.lineno, BLOCKING_OK)))
+        desc = self._blocking_desc(call)
+        if desc is not None:
+            self.unit.blockings.append(Blocking(
+                desc=desc, line=call.lineno, locks=locks,
+                unit=self.unit.name,
+                waived=self._marked(call.lineno, BLOCKING_OK)))
+
+    def _blocking_desc(self, call: ast.Call) -> Optional[str]:
+        f = call.func
+        has_timeout = (_kw(call, "timeout") is not None
+                       or _kw(call, "timeout_s") is not None)
+        if isinstance(f, ast.Attribute):
+            recv = f.value
+            if (f.attr == "sleep" and isinstance(recv, ast.Name)
+                    and recv.id == "time"):
+                return "time.sleep(...)"
+            if f.attr == "join" and not call.args and not has_timeout:
+                # 1-arg .join is str.join; 0-arg is a thread/process wait
+                return ".join() without timeout"
+            if f.attr == "wait" and not call.args and not has_timeout:
+                return ".wait() without timeout"
+            if f.attr == "get" and not call.args and not call.keywords:
+                # dict.get always takes a key; bare .get() is a queue
+                return ".get() without timeout"
+            if (f.attr == "put" and call.args and not has_timeout
+                    and isinstance(recv, ast.Attribute)
+                    and _is_self(recv.value)):
+                blk = _kw(call, "block")
+                if not (isinstance(blk, ast.Constant) and blk.value is False):
+                    return ".put(...) without timeout"
+            if f.attr == "communicate" and not has_timeout:
+                return ".communicate() without timeout"
+            if (f.attr in SUBPROCESS_WAITS and isinstance(recv, ast.Name)
+                    and recv.id == "subprocess" and not has_timeout):
+                return f"subprocess.{f.attr}(...) without timeout"
+        return None
+
+
+# ------------------------------------------------------- module scanning
+
+
+def _spawn_calls(root: ast.AST) -> List[ast.Call]:
+    out = []
+    for node in ast.walk(root):
+        if isinstance(node, ast.Call) and _type_name(node) in (
+                "Thread", "Process"):
+            out.append(node)
+    return out
+
+
+def _collect_lock_attrs(cls_node: ast.ClassDef, cls: ClassScan) -> None:
+    for node in ast.walk(cls_node):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        tname = _type_name(value)
+        if tname not in SYNCHRONIZED_TYPES:
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            if isinstance(t, ast.Attribute) and _is_self(t.value):
+                cls.sync_attrs.add(t.attr)
+                if tname in LOCK_TYPES:
+                    cls.lock_attrs.add(t.attr)
+                if tname == "RLock":
+                    cls.rlock_attrs.add(t.attr)
+
+
+def _thread_shared_decl(cls_node: ast.ClassDef
+                        ) -> Tuple[Optional[Tuple[str, ...]], int]:
+    for node in cls_node.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "_THREAD_SHARED"):
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                names = tuple(e.value for e in node.value.elts
+                              if isinstance(e, ast.Constant)
+                              and isinstance(e.value, str))
+                return names, node.lineno
+            return (), node.lineno
+    return None, 0
+
+
+def _base_names(cls_node: ast.ClassDef) -> Tuple[str, ...]:
+    out = []
+    for b in cls_node.bases:
+        if isinstance(b, ast.Attribute):
+            out.append(b.attr)
+        elif isinstance(b, ast.Name):
+            out.append(b.id)
+    return tuple(out)
+
+
+def _scan_class(mscan: ModuleScan, cls_node: ast.ClassDef, qual: str,
+                module_funcs: Set[str]) -> ClassScan:
+    cls = ClassScan(name=qual, line=cls_node.lineno,
+                    bases=_base_names(cls_node))
+    _collect_lock_attrs(cls_node, cls)
+    cls.thread_shared, cls.thread_shared_line = _thread_shared_decl(cls_node)
+    methods = [n for n in cls_node.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    method_names = {m.name for m in methods}
+
+    for meth in methods:
+        nested = {n.name: n for n in ast.walk(meth)
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                  and n is not meth}
+        # -------- discover spawns first: they define the unit split
+        entry_nodes: Dict[ast.AST, str] = {}   # nested node -> unit name
+        for call in _spawn_calls(meth):
+            tname = _type_name(call)
+            target = _kw(call, "target")
+            kind = "process" if tname == "Process" else "thread"
+            if target is None:
+                continue
+            if isinstance(target, ast.Attribute) and _is_self(target.value):
+                ident = f"{qual}.{target.attr}"
+                entry_unit = (target.attr
+                              if target.attr in method_names else None)
+            elif (isinstance(target, ast.Name) and target.id in nested):
+                ident = f"{qual}.{meth.name}:{target.id}"
+                entry_unit = f"{meth.name}:{target.id}"
+                if kind == "thread":
+                    entry_nodes[nested[target.id]] = entry_unit
+            elif isinstance(target, ast.Lambda):
+                ident = f"{qual}.{meth.name}:<lambda>"
+                entry_unit = f"{meth.name}:<lambda>"
+                if kind == "thread":
+                    entry_nodes[target] = entry_unit
+            elif isinstance(target, ast.Attribute):
+                ident = f"{qual}.{meth.name}:{target.attr}"
+                entry_unit = None
+            elif isinstance(target, ast.Name):
+                ident = f"{qual}.{meth.name}:{target.id}"
+                entry_unit = None
+            else:
+                ident = f"{qual}.{meth.name}:<target>"
+                entry_unit = None
+            if kind == "process":
+                ident = f"process:{ident}"
+                entry_unit = None     # separate address space
+            spawn = Spawn(ident=ident, line=call.lineno, kind=kind,
+                          entry_unit=entry_unit)
+            cls.spawns.append(spawn)
+            mscan.spawns.append(spawn)
+            if entry_unit is not None and kind == "thread":
+                cls.entries[entry_unit] = ident
+        # nested defs reachable from a nested thread entry run on that
+        # thread too (data.py's producer -> put_until_stopped chain)
+        reached: Dict[ast.AST, str] = dict(entry_nodes)
+        frontier = list(entry_nodes)
+        while frontier:
+            node = frontier.pop()
+            unit_name = reached[node]
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Name)
+                        and sub.func.id in nested):
+                    cand = nested[sub.func.id]
+                    if cand not in reached:
+                        reached[cand] = unit_name
+                        frontier.append(cand)
+
+        # -------- scan the method body (minus on-thread closures)
+        unit_key = (meth.name if meth.name not in cls.units
+                    else f"{meth.name}@{meth.lineno}")
+        unit = UnitScan(name=unit_key)
+        sc = _Scanner(mscan, cls, unit, module_funcs, method_names,
+                      skip_nodes=set(reached), watch_globals=set())
+        sc.scan_function(meth)
+        cls.units[unit_key] = unit
+        cls.by_name.setdefault(meth.name, []).append(unit_key)
+
+        # -------- scan each on-thread closure as its own unit
+        by_unit: Dict[str, List[ast.AST]] = collections.defaultdict(list)
+        for node, unit_name in reached.items():
+            by_unit[unit_name].append(node)
+        for unit_name, nodes in by_unit.items():
+            tunit = UnitScan(name=unit_name)
+            tsc = _Scanner(mscan, cls, tunit, module_funcs, method_names,
+                           skip_nodes=set(), watch_globals=set())
+            tsc.scan_bodies(nodes)
+            cls.units[unit_name] = tunit
+
+    # Thread subclass: run() is an entry on the spawned thread
+    if "Thread" in cls.bases and "run" in method_names:
+        cls.entries.setdefault("run", f"{qual}.run")
+        mscan.spawns.append(Spawn(ident=f"{qual}.run", line=cls.line,
+                                  kind="thread", entry_unit="run"))
+    # HTTP request handlers: do_* methods run on server threads
+    if any("RequestHandler" in b for b in cls.bases):
+        for m in sorted(method_names):
+            if m.startswith("do_"):
+                ident = f"handler:{qual}.{m}"
+                cls.entries.setdefault(m, ident)
+                mscan.spawns.append(Spawn(ident=ident, line=cls.line,
+                                          kind="handler", entry_unit=m))
+    return cls
+
+
+def scan_module(src: str, path: str,
+                watch_globals: Sequence[str] = ()) -> ModuleScan:
+    """Parse one module into its concurrency skeleton (no findings yet:
+    :func:`audit_modules` turns scans + contracts into findings)."""
+    tree = ast.parse(src)
+    mscan = ModuleScan(path=path, lines=src.splitlines())
+
+    module_funcs = {n.name for n in tree.body
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    for node in tree.body:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            value = node.value
+            if (isinstance(value, ast.Call)
+                    and _type_name(value) in LOCK_TYPES):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        mscan.module_locks.add(t.id)
+
+    def walk_scope(body: Sequence[ast.stmt], qual: str) -> None:
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                q = f"{qual}.{node.name}" if qual else node.name
+                mscan.classes.append(
+                    _scan_class(mscan, node, q, module_funcs))
+                walk_scope(node.body, q)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walk_scope(node.body, f"{qual}.{node.name}"
+                           if qual else node.name)
+
+    walk_scope(tree.body, "")
+
+    watch = set(watch_globals)
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            unit = UnitScan(name=node.name)
+            sc = _Scanner(mscan, None, unit, module_funcs, set(),
+                          skip_nodes=set(), watch_globals=watch)
+            sc.scan_function(node)
+            mscan.funcs[node.name] = unit
+            # module-level spawn sites (mplane's exporter thread)
+            for call in _spawn_calls(node):
+                tname = _type_name(call)
+                target = _kw(call, "target")
+                if target is None or _enclosed_in_class(call, mscan):
+                    continue
+                if isinstance(target, ast.Attribute):
+                    tid = target.attr
+                elif isinstance(target, ast.Name):
+                    tid = target.id
+                elif isinstance(target, ast.Lambda):
+                    tid = "<lambda>"
+                else:
+                    tid = "<target>"
+                ident = f"{node.name}:{tid}"
+                if tname == "Process":
+                    ident = f"process:{ident}"
+                mscan.spawns.append(Spawn(
+                    ident=ident, line=call.lineno,
+                    kind="process" if tname == "Process" else "thread",
+                    entry_unit=None))
+    return mscan
+
+
+def _enclosed_in_class(call: ast.Call, mscan: ModuleScan) -> bool:
+    """True when a spawn call line was already claimed by a class scan
+    (a method inside a class inside a module function is rare; class
+    scans record their spawns themselves)."""
+    return any(s.line == call.lineno for c in mscan.classes
+               for s in c.spawns)
+
+
+# ------------------------------------------------------ contracts + audit
+
+
+@dataclasses.dataclass(frozen=True)
+class ConcurrencyContract:
+    """Declarative per-module concurrency contract (additive, like
+    ``PassBudget``/``PlanContract``).
+
+    ``threads`` is the canonical inventory of the module's threads of
+    control (spawn sites, handlers, process entries) — drift in either
+    direction is a finding, so a new thread cannot land silently.
+    ``external_roots`` names class methods driven from threads the
+    module itself never spawns (``{"ServingRuntime": {"submit":
+    "realtime-driver", ...}}``). ``shared_globals`` are module-level
+    names shared across threads whose mutations must hold a
+    module-level lock."""
+
+    module: str
+    threads: Tuple[str, ...] = ()
+    external_roots: Mapping[str, Mapping[str, str]] = dataclasses.field(
+        default_factory=dict)
+    shared_globals: Tuple[str, ...] = ()
+    reason: str = ""
+
+
+def _roots_by_unit(cls: ClassScan,
+                   external: Mapping[str, str]) -> Dict[str, FrozenSet[str]]:
+    """Assign every unit its set of threads of control.
+
+    Thread entries seed their own root. Public methods (and private
+    methods never called intra-class — their callers are outside) seed
+    the ``caller`` root. ``external_roots`` add declared cross-thread
+    drivers. Roots then propagate along intra-class call edges to a
+    fixpoint, so a helper only called from the monitor loop carries
+    exactly the monitor root. ``__init__`` (and other dunders) seed
+    nothing: construction precedes concurrency."""
+    roots: Dict[str, Set[str]] = {u: set() for u in cls.units}
+    indeg: Dict[str, int] = {u: 0 for u in cls.units}
+    edges: List[Tuple[str, str]] = []
+    for u, unit in cls.units.items():
+        for call in unit.calls:
+            if call.callee[0] != "self":
+                continue
+            for v in cls.by_name.get(call.callee[1], []):
+                edges.append((u, v))
+                indeg[v] += 1
+    for u, ident in cls.entries.items():
+        if u in roots:
+            roots[u].add(ident)
+    for u in cls.units:
+        meth = u.split("@")[0]
+        if ":" in u or u in cls.entries:
+            continue
+        if meth.startswith("__") and meth != "__call__":
+            continue
+        if not meth.startswith("_") or indeg[u] == 0:
+            roots[u].add("caller")
+    for meth, root in external.items():
+        for u in cls.by_name.get(meth, []):
+            roots[u].add(root)
+    changed = True
+    while changed:
+        changed = False
+        for u, v in edges:
+            if not roots[u] <= roots[v]:
+                roots[v] |= roots[u]
+                changed = True
+    return {u: frozenset(r) for u, r in roots.items()}
+
+
+def _shared_attr_findings(mscan: ModuleScan, cls: ClassScan,
+                          roots: Dict[str, FrozenSet[str]]
+                          ) -> List[ConcFinding]:
+    by_attr: Dict[str, List[Access]] = collections.defaultdict(list)
+    for unit in cls.units.values():
+        for a in unit.accesses:
+            by_attr[a.attr].append(a)
+    declared = set(cls.thread_shared or ())
+    out: List[ConcFinding] = []
+    for attr, accesses in sorted(by_attr.items()):
+        if attr in cls.sync_attrs:
+            continue
+        attr_roots = set()
+        for a in accesses:
+            attr_roots |= roots.get(a.unit, frozenset())
+        muts = [a for a in accesses if a.kind in ("write", "mutate")
+                and a.unit.split("@")[0] != "__init__"]
+        if not muts:
+            continue
+        if len(attr_roots) < 2 and attr not in declared:
+            continue
+        guards = sorted({lk for a in accesses for lk in a.locks})
+        for a in muts:
+            if a.locks or a.waived:
+                continue
+            rtxt = ", ".join(sorted(attr_roots)) or "caller"
+            hint = (f"; other sites guard it with {', '.join(guards)}"
+                    if guards else "")
+            out.append(ConcFinding(
+                "unguarded-shared", mscan.path, a.line,
+                f"{cls.name}.{attr} mutated without a lock but shared "
+                f"across threads of control [{rtxt}]{hint}; guard the "
+                f"mutation or annotate '# {THREAD_LOCAL_OK} <reason>'"))
+    # declared-but-nonexistent attrs keep _THREAD_SHARED honest
+    for attr in sorted(declared - set(by_attr)):
+        out.append(ConcFinding(
+            "contract-drift", mscan.path, cls.thread_shared_line,
+            f"{cls.name}._THREAD_SHARED declares '{attr}' but no such "
+            f"attribute is accessed in the class"))
+    return out
+
+
+def _blocking_findings(mscan: ModuleScan) -> List[ConcFinding]:
+    """Direct blocking-while-locked sites plus calls that bubble a
+    blocking callee up under a held lock (intra-module resolution)."""
+    units: Dict[Tuple[str, str], UnitScan] = {}
+    name_map: Dict[Tuple[str, str], List[Tuple[str, str]]] = {}
+    for cls in mscan.classes:
+        for u, unit in cls.units.items():
+            units[(cls.name, u)] = unit
+        for meth, unit_names in cls.by_name.items():
+            name_map[(cls.name, meth)] = [(cls.name, u) for u in unit_names]
+    for fname, unit in mscan.funcs.items():
+        units[("", fname)] = unit
+        name_map[("", fname)] = [("", fname)]
+
+    def callees(key: Tuple[str, str], call: CallSite
+                ) -> List[Tuple[str, str]]:
+        if call.callee[0] == "self":
+            return name_map.get((key[0], call.callee[1]), [])
+        return name_map.get(("", call.callee[1]), [])
+
+    may_block: Dict[Tuple[str, str], Set[str]] = {
+        k: {b.desc for b in u.blockings if not b.waived}
+        for k, u in units.items()}
+    changed = True
+    while changed:
+        changed = False
+        for k, u in units.items():
+            for call in u.calls:
+                for c in callees(k, call):
+                    extra = may_block.get(c, set()) - may_block[k]
+                    if extra:
+                        may_block[k] |= extra
+                        changed = True
+
+    out: List[ConcFinding] = []
+    for k, u in units.items():
+        for b in u.blockings:
+            if b.locks and not b.waived:
+                out.append(ConcFinding(
+                    "blocking-under-lock", mscan.path, b.line,
+                    f"{b.desc} while holding {', '.join(sorted(b.locks))}"
+                    f" — a blocked lock holder stalls every other thread"
+                    f" of control; move the wait outside the lock or "
+                    f"annotate '# {BLOCKING_OK} <reason>'"))
+        for call in u.calls:
+            if not call.locks or call.waived:
+                continue
+            bubbled = set()
+            for c in callees(k, call):
+                bubbled |= may_block.get(c, set())
+            if bubbled:
+                out.append(ConcFinding(
+                    "blocking-under-lock", mscan.path, call.line,
+                    f"call under {', '.join(sorted(call.locks))} reaches "
+                    f"a blocking operation ({', '.join(sorted(bubbled))})"
+                    f"; move the call outside the lock or annotate "
+                    f"'# {BLOCKING_OK} <reason>'"))
+    return out
+
+
+def _lock_edges(mscan: ModuleScan
+                ) -> Dict[Tuple[str, str], Tuple[str, int]]:
+    """Directed held->acquired edges, with acquisitions propagated
+    through intra-module calls (a callee's acquisitions happen while
+    the caller's locks are held). Waived acquisitions contribute no
+    edges and do not propagate."""
+    units: Dict[Tuple[str, str], UnitScan] = {}
+    name_map: Dict[Tuple[str, str], List[Tuple[str, str]]] = {}
+    for cls in mscan.classes:
+        for u, unit in cls.units.items():
+            units[(cls.name, u)] = unit
+        for meth, unit_names in cls.by_name.items():
+            name_map[(cls.name, meth)] = [(cls.name, u) for u in unit_names]
+    for fname, unit in mscan.funcs.items():
+        units[("", fname)] = unit
+        name_map[("", fname)] = [("", fname)]
+
+    may_acquire: Dict[Tuple[str, str], Set[str]] = {
+        k: {a.lock for a in u.acquisitions if not a.waived}
+        for k, u in units.items()}
+    changed = True
+    while changed:
+        changed = False
+        for k, u in units.items():
+            for call in u.calls:
+                keys = (name_map.get((k[0], call.callee[1]), [])
+                        if call.callee[0] == "self"
+                        else name_map.get(("", call.callee[1]), []))
+                for c in keys:
+                    extra = may_acquire.get(c, set()) - may_acquire[k]
+                    if extra:
+                        may_acquire[k] |= extra
+                        changed = True
+
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    for k, u in units.items():
+        for a in u.acquisitions:
+            if a.waived:
+                continue
+            for h in a.held:
+                edges.setdefault((h, a.lock), (mscan.path, a.line))
+        for call in u.calls:
+            if not call.locks:
+                continue
+            keys = (name_map.get((k[0], call.callee[1]), [])
+                    if call.callee[0] == "self"
+                    else name_map.get(("", call.callee[1]), []))
+            for c in keys:
+                for acq in may_acquire.get(c, set()):
+                    for h in call.locks:
+                        edges.setdefault((h, acq),
+                                         (mscan.path, call.line))
+    return edges
+
+
+def _cycles(edges: Dict[Tuple[str, str], Tuple[str, int]],
+            reentrant: FrozenSet[str] = frozenset()) -> List[List[str]]:
+    graph: Dict[str, Set[str]] = collections.defaultdict(set)
+    for a, b in edges:
+        if a == b and a in reentrant:
+            continue        # RLock re-acquisition, not a deadlock
+        graph[a].add(b)
+    # Tarjan SCC; report components of size > 1 and self-loops
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    onstack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        work = [(v, iter(sorted(graph.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        onstack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    onstack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                if w in onstack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    onstack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                sccs.append(comp)
+
+    for v in sorted(set(graph) | {b for bs in graph.values() for b in bs}):
+        if v not in index:
+            strongconnect(v)
+    out = []
+    for comp in sccs:
+        if len(comp) > 1:
+            out.append(sorted(comp))
+        elif comp[0] in graph.get(comp[0], set()):
+            out.append(comp)
+    return out
+
+
+def _contract_findings(mscan: ModuleScan,
+                       contract: Optional[ConcurrencyContract]
+                       ) -> List[ConcFinding]:
+    out: List[ConcFinding] = []
+    discovered = {s.ident for s in mscan.spawns}
+    if contract is None:
+        if discovered:
+            first = min(mscan.spawns, key=lambda s: s.line)
+            out.append(ConcFinding(
+                "contract-drift", mscan.path, first.line,
+                f"module spawns threads of control ({', '.join(sorted(discovered))}) "
+                f"but declares no ConcurrencyContract — add one to "
+                f"analysis.concurrency_audit.REFERENCE_CONTRACTS"))
+        return out
+    declared = set(contract.threads)
+    for ident in sorted(discovered - declared):
+        line = min(s.line for s in mscan.spawns if s.ident == ident)
+        out.append(ConcFinding(
+            "contract-drift", mscan.path, line,
+            f"undeclared thread of control '{ident}' — add it to the "
+            f"module's ConcurrencyContract.threads"))
+    for ident in sorted(declared - discovered):
+        out.append(ConcFinding(
+            "contract-drift", mscan.path, 1,
+            f"ConcurrencyContract declares thread '{ident}' but no such "
+            f"spawn site exists (stale contract)"))
+    class_names = {c.name for c in mscan.classes}
+    for cname, meths in contract.external_roots.items():
+        cls = next((c for c in mscan.classes if c.name == cname), None)
+        if cls is None:
+            out.append(ConcFinding(
+                "contract-drift", mscan.path, 1,
+                f"ConcurrencyContract names external roots for missing "
+                f"class '{cname}' (have: {', '.join(sorted(class_names))})"))
+            continue
+        for meth in meths:
+            if meth not in cls.by_name:
+                out.append(ConcFinding(
+                    "contract-drift", mscan.path, cls.line,
+                    f"ConcurrencyContract names external root for "
+                    f"missing method '{cname}.{meth}'"))
+    watched = set(contract.shared_globals)
+    seen = set()
+    for a in mscan.global_accesses:
+        if a.attr not in watched:
+            continue
+        seen.add(a.attr)
+        if not a.locks and not a.waived:
+            out.append(ConcFinding(
+                "global-unguarded", mscan.path, a.line,
+                f"shared module global '{a.attr}' mutated outside any "
+                f"module-level lock; guard it or annotate "
+                f"'# {THREAD_LOCAL_OK} <reason>'"))
+    for name in sorted(watched - seen):
+        # declared but never mutated in module functions: fine (may be
+        # read-only or mutated only at import time) — not a finding
+        pass
+    return out
+
+
+@dataclasses.dataclass
+class AuditReport:
+    """Aggregated Half-1 result over a set of modules."""
+
+    findings: List[ConcFinding]
+    inventory: Dict[str, List[str]]          # module -> thread idents
+    lock_edges: Dict[Tuple[str, str], Tuple[str, int]]
+    cycles: List[List[str]]
+    modules: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "modules": self.modules,
+            "findings": [dataclasses.asdict(f) for f in self.findings],
+            "inventory": self.inventory,
+            "lock_edges": [
+                {"from": a, "to": b, "path": p, "line": ln}
+                for (a, b), (p, ln) in sorted(self.lock_edges.items())],
+            "cycles": self.cycles,
+        }
+
+
+def audit_modules(scans: Sequence[ModuleScan],
+                  contracts: Mapping[str, ConcurrencyContract]
+                  ) -> AuditReport:
+    """Run every Half-1 check over pre-parsed module scans."""
+    findings: List[ConcFinding] = []
+    inventory: Dict[str, List[str]] = {}
+    all_edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    reentrant: Set[str] = set()
+    for mscan in scans:
+        for cls in mscan.classes:
+            reentrant.update(f"{cls.name}.{a}" for a in cls.rlock_attrs)
+        contract = contracts.get(mscan.path)
+        ext = contract.external_roots if contract else {}
+        for cls in mscan.classes:
+            roots = _roots_by_unit(cls, ext.get(cls.name, {}))
+            findings.extend(_shared_attr_findings(mscan, cls, roots))
+        findings.extend(_blocking_findings(mscan))
+        findings.extend(_contract_findings(mscan, contract))
+        for edge, site in _lock_edges(mscan).items():
+            all_edges.setdefault(edge, site)
+        if mscan.spawns:
+            inventory[mscan.path] = sorted({s.ident for s in mscan.spawns})
+    cycles = _cycles(all_edges, frozenset(reentrant))
+    for comp in cycles:
+        path, line = min(
+            (all_edges[(a, b)] for (a, b) in all_edges
+             if a in comp and b in comp), default=("<graph>", 0))
+        findings.append(ConcFinding(
+            "lock-order-cycle", path, line,
+            f"lock-acquisition-order cycle: {' -> '.join(comp + comp[:1])}"
+            f" — a consistent global order (or an id-ordered acquisition "
+            f"with '# {LOCK_ORDER_OK} <reason>') is required"))
+    findings.sort(key=lambda f: (f.path, f.line, f.kind))
+    return AuditReport(findings=findings, inventory=inventory,
+                       lock_edges=all_edges, cycles=cycles,
+                       modules=len(scans))
+
+
+def audit_source(src: str, path: str,
+                 contract: Optional[ConcurrencyContract] = None
+                 ) -> AuditReport:
+    """Audit one in-memory module (unit tests + the seeded drills)."""
+    contracts = {path: contract} if contract else {}
+    watch = contract.shared_globals if contract else ()
+    return audit_modules([scan_module(src, path, watch)], contracts)
+
+
+def package_root() -> str:
+    """Absolute path of the installed ``distributed_embeddings_tpu``
+    package directory (the scan root)."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def audit_repo(root: Optional[str] = None,
+               contracts: Optional[Mapping[str, ConcurrencyContract]] = None
+               ) -> AuditReport:
+    """Scan every package module and audit it against
+    :data:`REFERENCE_CONTRACTS` (module paths are package-relative,
+    ``parallel/serving.py`` style)."""
+    root = package_root() if root is None else root
+    contracts = REFERENCE_CONTRACTS if contracts is None else contracts
+    scans = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, root).replace(os.sep, "/")
+            with open(full, "r", encoding="utf-8") as f:
+                src = f.read()
+            contract = contracts.get(rel)
+            watch = contract.shared_globals if contract else ()
+            scans.append(scan_module(src, rel, watch))
+    return audit_modules(scans, contracts)
+
+
+# ------------------------------------------------- the reference contracts
+
+#: The serving plane's thread-shared-state contracts. Additive: a new
+#: concurrent module (or a new thread in a contracted one) fails the
+#: gate until its contract names the thread of control.
+REFERENCE_CONTRACTS: Dict[str, ConcurrencyContract] = {
+    c.module: c for c in (
+        ConcurrencyContract(
+            module="parallel/supervisor.py",
+            threads=(
+                "Supervisor._monitor_loop",
+                "Supervisor._send_loop",
+                "Supervisor._spawn_worker:<lambda>",
+                "process:Supervisor._spawn_worker:_worker_main",
+            ),
+            reason="monitor owns the socket + crash path; sender drains "
+                   "the send queue; the accept lambda bounds worker "
+                   "connect; the worker is a spawn-context process "
+                   "(own address space — excluded from shared state)"),
+        ConcurrencyContract(
+            module="parallel/serving.py",
+            threads=("RealtimeDriver._run",),
+            external_roots={
+                # In OnlineRuntime realtime mode ONE ServingRuntime is
+                # driven from two threads the module never spawns: the
+                # RealtimeDriver submits/polls while the trainer thread
+                # publishes snapshots; the mplane exporter thread may
+                # scrape _collect mid-load (check_obsplane drill).
+                "ServingRuntime": {
+                    "submit": "realtime-driver",
+                    "poll": "realtime-driver",
+                    "flush": "realtime-driver",
+                    "install_snapshot": "trainer",
+                    "note_train_step": "trainer",
+                    "_collect": "metrics-exporter",
+                },
+            },
+            reason="open-loop realtime arrivals vs trainer-side RCU "
+                   "snapshot publication on one runtime instance"),
+        ConcurrencyContract(
+            module="utils/obs.py",
+            threads=(),
+            shared_globals=(
+                "_counters", "_events", "_event_taps",
+                "_server_started", "_compile_listener_installed",
+            ),
+            reason="module-level counters/events are written from "
+                   "serving, supervisor and exporter threads; every "
+                   "mutation holds the module lock"),
+        ConcurrencyContract(
+            module="utils/mplane.py",
+            threads=(
+                "start_http_exporter:serve_forever",
+                "handler:start_http_exporter.Handler.do_GET",
+            ),
+            reason="the scrape endpoint renders the registry from "
+                   "server threads while hot paths observe into "
+                   "sketches; lock hierarchy registry -> family -> "
+                   "sketch, sketch merge id-ordered"),
+        ConcurrencyContract(
+            module="utils/data.py",
+            threads=("RawBinaryDataset._iter_range:producer",),
+            reason="one bounded-queue prefetch producer per iteration; "
+                   "it touches only closure state + the synchronized "
+                   "queue/stop-event pair"),
+    )
+}
+
+
+# ====================================================================
+# Half 2 — explicit-state interleaving model checker
+# ====================================================================
+
+
+@dataclasses.dataclass(frozen=True)
+class Model:
+    """An explicit-state transition system: hashable states, string
+    action labels, deterministic ``step``, named invariants checked on
+    every reachable state."""
+
+    name: str
+    initial: Any
+    enabled: Callable[[Any], Tuple[str, ...]]
+    step: Callable[[Any, str], Any]
+    invariants: Mapping[str, Callable[[Any], bool]]
+
+
+@dataclasses.dataclass(frozen=True)
+class ProofResult:
+    """Outcome of one exhaustive exploration."""
+
+    model: str
+    ok: bool
+    states: int
+    transitions: int
+    violated: Optional[str] = None
+    trace: Tuple[str, ...] = ()
+
+    def __str__(self) -> str:
+        if self.ok:
+            return (f"{self.model}: PROVED over {self.states} states / "
+                    f"{self.transitions} transitions")
+        return (f"{self.model}: VIOLATED '{self.violated}' after "
+                f"{len(self.trace)} steps: {' -> '.join(self.trace)}")
+
+
+def explore(model: Model, max_states: int = 500_000) -> ProofResult:
+    """Exhaustive BFS over every interleaving of ``model``.
+
+    Checks every invariant on every reachable state; on the first
+    violation, reconstructs the shortest action trace (the
+    counterexample a refuted mutant prints). Raises ``RuntimeError``
+    past ``max_states`` — an unbounded model is an authoring bug, not
+    a proof."""
+    parent: Dict[Any, Optional[Tuple[Any, str]]] = {model.initial: None}
+    frontier = collections.deque([model.initial])
+    transitions = 0
+
+    def violation(state: Any) -> Optional[str]:
+        for name, inv in model.invariants.items():
+            if not inv(state):
+                return name
+        return None
+
+    def trace_to(state: Any) -> Tuple[str, ...]:
+        acts: List[str] = []
+        cur = state
+        while parent[cur] is not None:
+            prev, act = parent[cur]
+            acts.append(act)
+            cur = prev
+        return tuple(reversed(acts))
+
+    bad = violation(model.initial)
+    if bad is not None:
+        return ProofResult(model.name, False, 1, 0, bad, ())
+    while frontier:
+        state = frontier.popleft()
+        for action in model.enabled(state):
+            nxt = model.step(state, action)
+            transitions += 1
+            if nxt in parent:
+                continue
+            parent[nxt] = (state, action)
+            bad = violation(nxt)
+            if bad is not None:
+                return ProofResult(model.name, False, len(parent),
+                                   transitions, bad, trace_to(nxt))
+            if len(parent) > max_states:
+                raise RuntimeError(
+                    f"model '{model.name}' exceeded {max_states} states "
+                    f"— not a bounded model")
+            frontier.append(nxt)
+    return ProofResult(model.name, True, len(parent), transitions)
+
+
+def prove(model: Model, max_states: int = 500_000) -> ProofResult:
+    """Explore and require every invariant to hold."""
+    return explore(model, max_states)
+
+
+def refute(model: Model, max_states: int = 500_000) -> ProofResult:
+    """Explore a seeded mutant and require a counterexample (the
+    drill: a checker that cannot refute a broken protocol proves
+    nothing)."""
+    return explore(model, max_states)
+
+
+# ----------------------------------------------------------- seqlock model
+
+# Reader program counters
+_R_IDLE, _R_HDR, _R_COPY, _R_VERIFY = 0, 1, 2, 3
+
+
+def seqlock_model(mutant: Optional[str] = None, *, publishes: int = 3,
+                  words: int = 2, retries: int = 2,
+                  reads: int = 2) -> Model:
+    """The ``utils/shm.py`` seqlock at word granularity.
+
+    Writer per publish ``s`` (buffer ``s % 2``): one atomic header pack
+    (``begin=s, end=0, crc=crc(payload_s)`` — one struct.pack slice
+    write in the real code), then ``words`` separate payload-word
+    writes, then the ``end=s`` stamp, then the ``latest`` flip. Reader
+    per attempt: snapshot ``latest``, read the header atomically,
+    require ``begin == end != 0``, copy the payload word by word, then
+    verify the CRC over the *copied* words against the copied header
+    (a mixed copy hashes to a distinct value — crc32's job here).
+    ``publishes >= 3`` makes lapping reachable: seqs 1 and 3 share
+    buffer 1, so a reader holding seq-1's header can race seq-3's
+    overwrite mid-copy.
+
+    Mutants: ``no_crc`` skips the verify (a lapped torn copy is then
+    accepted — violates ``no-torn-accept``); ``stamps_swapped`` writes
+    the end-stamp up-front with the header (the buffer claims
+    completeness over stale words — violates ``stamp-honesty``)."""
+    if mutant not in (None, "no_crc", "stamps_swapped"):
+        raise ValueError(f"unknown seqlock mutant: {mutant}")
+    W = int(words)
+    empty_buf = (0, 0, 0, (0,) * W)
+
+    # state: (w_seq, w_pc, bufs, latest, r_pc, r_hdr, r_copied,
+    #         r_attempt, r_done, last_accept)
+    # w_pc: 0 = header next; 1..W = word w_pc-1 next; W+1 = stamp next;
+    #       W+2 = flip next
+    initial = (1, 0, (empty_buf, empty_buf), 0,
+               _R_IDLE, None, (), 0, 0, None)
+
+    def enabled(s: Any) -> Tuple[str, ...]:
+        (w_seq, w_pc, bufs, latest, r_pc, r_hdr, r_copied,
+         r_attempt, r_done, last_accept) = s
+        acts: List[str] = []
+        if w_seq <= publishes:
+            acts.append("writer")
+        if r_done < reads:
+            acts.append("reader")
+        return tuple(acts)
+
+    def step(s: Any, action: str) -> Any:
+        (w_seq, w_pc, bufs, latest, r_pc, r_hdr, r_copied,
+         r_attempt, r_done, last_accept) = s
+        bufs = list(bufs)
+        if action == "writer":
+            b = w_seq % 2
+            begin, end, crc, wrds = bufs[b]
+            if w_pc == 0:                       # atomic header pack
+                end0 = w_seq if mutant == "stamps_swapped" else 0
+                bufs[b] = (w_seq, end0, w_seq, wrds)
+                w_pc = 1
+            elif w_pc <= W:                     # payload word w_pc-1
+                wl = list(wrds)
+                wl[w_pc - 1] = w_seq
+                bufs[b] = (begin, end, crc, tuple(wl))
+                w_pc += 1
+            elif w_pc == W + 1:                 # end-stamp
+                bufs[b] = (begin, w_seq, crc, wrds)
+                w_pc += 1
+            else:                               # latest flip
+                latest = w_seq
+                w_seq += 1
+                w_pc = 0
+        else:
+            def give_up_or_retry():
+                # bounded retries, then None (keep previous snapshot)
+                if r_attempt + 1 >= retries:
+                    return _R_IDLE, None, (), 0, r_done + 1
+                return _R_IDLE, None, (), r_attempt + 1, r_done
+            if r_pc == _R_IDLE:
+                if latest == 0:                 # nothing published yet
+                    r_done += 1
+                else:
+                    r_hdr = bufs[latest % 2][:3]    # atomic header read
+                    r_pc = _R_HDR
+            elif r_pc == _R_HDR:
+                begin, end, crc = r_hdr
+                if begin == end and begin != 0:
+                    r_pc, r_copied = _R_COPY, ()
+                else:
+                    r_pc, r_hdr, r_copied, r_attempt, r_done = \
+                        give_up_or_retry()
+            elif r_pc == _R_COPY:
+                b = r_hdr[0] % 2
+                r_copied = r_copied + (bufs[b][3][len(r_copied)],)
+                if len(r_copied) == W:
+                    r_pc = _R_VERIFY
+            else:                               # _R_VERIFY
+                begin, end, crc = r_hdr
+                uniform = len(set(r_copied)) == 1
+                computed = r_copied[0] if uniform else -1
+                ok = (computed == crc) or mutant == "no_crc"
+                if ok:
+                    last_accept = (begin, crc, r_copied)
+                    r_pc, r_hdr, r_copied, r_attempt = _R_IDLE, None, (), 0
+                    r_done += 1
+                else:
+                    r_pc, r_hdr, r_copied, r_attempt, r_done = \
+                        give_up_or_retry()
+        return (w_seq, w_pc, tuple(bufs), latest, r_pc, r_hdr,
+                r_copied, r_attempt, r_done, last_accept)
+
+    def no_torn_accept(s: Any) -> bool:
+        last_accept = s[9]
+        if last_accept is None:
+            return True
+        begin, crc, copied = last_accept
+        return (len(set(copied)) == 1 and copied[0] == begin
+                and 1 <= begin <= publishes)
+
+    def stamp_honesty(s: Any) -> bool:
+        for begin, end, crc, wrds in s[2]:
+            if begin == end and begin != 0:
+                if any(w != begin for w in wrds) or crc != begin:
+                    return False
+        return True
+
+    def writer_never_blocks(s: Any) -> bool:
+        return s[0] > publishes or "writer" in enabled(s)
+
+    def bounded_retries(s: Any) -> bool:
+        return s[7] < retries
+
+    return Model(
+        name=f"seqlock[{mutant or 'faithful'}]",
+        initial=initial, enabled=enabled, step=step,
+        invariants={
+            "no-torn-accept": no_torn_accept,
+            "stamp-honesty": stamp_honesty,
+            "writer-never-blocks": writer_never_blocks,
+            "bounded-retries": bounded_retries,
+        })
+
+
+# -------------------------------------------------------- supervisor model
+
+_ALIVE, _HUNG, _DEAD, _RESTARTING, _EXHAUSTED = 0, 1, 2, 3, 4
+
+
+def supervisor_model(mutant: Optional[str] = None, *, ticks: int = 8,
+                     submits: int = 2, publishes: int = 2,
+                     faults: int = 2, restarts: int = 2,
+                     deadline: int = 2, backoff: int = 1) -> Model:
+    """The ``parallel/supervisor.py`` heartbeat state machine, round-
+    based on a virtual clock (``tick`` advances time then runs the
+    monitor's checks — exactly the real monitor loop's shape).
+
+    An ALIVE worker pongs unconditionally on every monitor pass (the
+    worker's pong loop has no slow path — *failing* to pong IS the
+    hang, which is why the deadline is a meaningful detector).
+
+    Worker actions: serve (answers the
+    lowest in-flight rid), crash (socket EOF — detected on the next
+    monitor pass), hang (alive process, frozen pongs — detected only by
+    the deadline). Caller actions: submit (rid assignment; during an
+    outage the crash path answers a typed Unavailable immediately),
+    publish (the seqlock write — enabled in EVERY state by
+    construction, which the ``publish-never-blocks`` invariant makes
+    explicit), ingest (an alive worker reads the latest snapshot). The
+    monitor detects a crash on its next pass and a hang once
+    ``now - last_pong > deadline``, answers every stranded rid, then
+    restarts under the budget; a reborn worker re-ingests the latest
+    published snapshot before serving.
+
+    Mutant ``deadline_off_by_one`` declares the hang one tick late
+    (``> deadline + 1``) — violates ``hang-detected-within-deadline``:
+    a state exists where the worker has been silent longer than the
+    contract allows yet is still undetected."""
+    if mutant not in (None, "deadline_off_by_one"):
+        raise ValueError(f"unknown supervisor mutant: {mutant}")
+    limit = deadline + (1 if mutant == "deadline_off_by_one" else 0)
+
+    # state: (now, status, last_pong, restart_at, n_restarts, next_rid,
+    #         lo, n_served, n_unavail, published, ingested, subs_left,
+    #         faults_left)
+    initial = (0, _ALIVE, 0, 0, 0, 0, 0, 0, 0, 0, 0, submits, faults)
+
+    def enabled(s: Any) -> Tuple[str, ...]:
+        (now, status, last_pong, restart_at, n_restarts, next_rid, lo,
+         n_served, n_unavail, published, ingested, subs_left,
+         faults_left) = s
+        acts: List[str] = []
+        if subs_left > 0:
+            acts.append("submit")
+        if published < publishes:
+            acts.append("publish")      # NEVER gated on worker status
+        if status == _ALIVE:
+            if lo < next_rid:
+                acts.append("serve")
+            if ingested < published:
+                acts.append("ingest")
+            if faults_left > 0:
+                acts.append("crash")
+                acts.append("hang")
+        if now < ticks:
+            acts.append("tick")
+        return tuple(acts)
+
+    def step(s: Any, action: str) -> Any:
+        (now, status, last_pong, restart_at, n_restarts, next_rid, lo,
+         n_served, n_unavail, published, ingested, subs_left,
+         faults_left) = s
+        if action == "submit":
+            subs_left -= 1
+            next_rid += 1
+            if status in (_RESTARTING, _EXHAUSTED):
+                # DETECTED outage: the crash path answers a typed
+                # Unavailable immediately, rid assignment stays
+                # monotone. (An *undetected* crash/hang leaves the rid
+                # in flight; the monitor's detection pass answers it.)
+                lo = next_rid
+                n_unavail += 1
+        elif action == "publish":
+            published += 1
+        elif action == "ingest":
+            ingested = published
+        elif action == "serve":
+            lo += 1
+            n_served += 1
+        elif action == "crash":
+            status = _DEAD
+            faults_left -= 1
+        elif action == "hang":
+            status = _HUNG
+            faults_left -= 1
+        else:                           # tick: clock, then monitor pass
+            now += 1
+            detected = (status == _DEAD
+                        or (status == _HUNG and now - last_pong > limit))
+            if detected:
+                n_unavail += next_rid - lo      # answer every stranded rid
+                lo = next_rid
+                if n_restarts >= restarts:
+                    status = _EXHAUSTED
+                else:
+                    n_restarts += 1
+                    status = _RESTARTING
+                    restart_at = now + backoff
+            elif status == _RESTARTING and now >= restart_at:
+                status = _ALIVE
+                last_pong = now
+                ingested = published    # re-ingest BEFORE serving
+            elif status == _ALIVE:
+                last_pong = now         # an alive worker always pongs
+        return (now, status, last_pong, restart_at, n_restarts, next_rid,
+                lo, n_served, n_unavail, published, ingested, subs_left,
+                faults_left)
+
+    def conservation(s: Any) -> bool:
+        # every rid below lo answered exactly once, everything at or
+        # above lo still in flight: served + unavailable == answered
+        return s[7] + s[8] == s[6] and s[6] <= s[5]
+
+    def rid_monotone(s: Any) -> bool:
+        # restarts never rewind rid assignment (lo/next_rid only grow
+        # by construction; EXHAUSTED leaves nothing stranded)
+        if s[1] == _EXHAUSTED:
+            return s[6] == s[5]
+        return 0 <= s[6] <= s[5] <= submits
+
+    def hang_detected(s: Any) -> bool:
+        return s[1] != _HUNG or s[0] - s[2] <= deadline
+
+    def publish_never_blocks(s: Any) -> bool:
+        return s[9] >= publishes or "publish" in enabled(s)
+
+    def ingest_monotone(s: Any) -> bool:
+        return 0 <= s[10] <= s[9] <= publishes
+
+    def budget_respected(s: Any) -> bool:
+        return s[4] <= restarts
+
+    return Model(
+        name=f"supervisor[{mutant or 'faithful'}]",
+        initial=initial, enabled=enabled, step=step,
+        invariants={
+            "request-conservation": conservation,
+            "rid-monotone": rid_monotone,
+            "hang-detected-within-deadline": hang_detected,
+            "publish-never-blocks": publish_never_blocks,
+            "reingest-monotone": ingest_monotone,
+            "restart-budget-respected": budget_respected,
+        })
+
+
+#: the seeded protocol mutants the CLI must REFUTE (name -> builder)
+MUTANTS: Dict[str, Callable[[], Model]] = {
+    "seqlock:no_crc": lambda **kw: seqlock_model("no_crc", **kw),
+    "seqlock:stamps_swapped":
+        lambda **kw: seqlock_model("stamps_swapped", **kw),
+    "supervisor:deadline_off_by_one":
+        lambda **kw: supervisor_model("deadline_off_by_one", **kw),
+}
+
+
+# ------------------------------------------------------------ seeded drills
+
+#: unguarded cross-thread mutation — MUST fire "unguarded-shared"
+DRILL_UNGUARDED_SRC = '''
+import threading
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            self._count += 1
+
+    def bump(self):
+        self._count += 1
+'''
+
+#: inconsistent two-lock order — MUST fire "lock-order-cycle"
+DRILL_CYCLE_SRC = '''
+import threading
+
+class Transfer:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def ba(self):
+        with self._b:
+            with self._a:
+                pass
+'''
+
+#: sleep inside a critical section — MUST fire "blocking-under-lock"
+DRILL_BLOCKING_SRC = '''
+import threading
+import time
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._v = 0
+
+    def poll(self):
+        with self._lock:
+            time.sleep(0.1)
+            self._v += 1
+'''
+
+
+def run_drills(max_states: int = 500_000) -> List[str]:
+    """Self-drill the auditor; returns failure strings (empty = pass).
+
+    The Half-1 drills feed seeded-broken sources through the same
+    analysis as the repo scan and require each finding kind to fire;
+    the Half-2 drills require the faithful models to PROVE and every
+    seeded mutant to be REFUTED — an explorer that can't tell a broken
+    protocol from a correct one gates nothing."""
+    failures: List[str] = []
+    for name, src, kind in (
+            ("unguarded-attribute", DRILL_UNGUARDED_SRC, "unguarded-shared"),
+            ("lock-order-cycle", DRILL_CYCLE_SRC, "lock-order-cycle"),
+            ("blocking-under-lock", DRILL_BLOCKING_SRC,
+             "blocking-under-lock")):
+        contract = ConcurrencyContract(module=f"<drill:{name}>",
+                                       threads=("Worker.start",))
+        rep = audit_source(src, f"<drill:{name}>")
+        if not any(f.kind == kind for f in rep.findings):
+            failures.append(
+                f"drill '{name}' did not fire a {kind} finding")
+    for model in (seqlock_model(), supervisor_model()):
+        res = prove(model, max_states)
+        if not res.ok:
+            failures.append(f"faithful model failed to prove: {res}")
+    for name, build in MUTANTS.items():
+        res = refute(build(), max_states)
+        if res.ok:
+            failures.append(
+                f"mutant '{name}' was NOT refuted — the explorer "
+                f"cannot distinguish a broken protocol")
+    return failures

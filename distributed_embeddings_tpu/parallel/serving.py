@@ -357,6 +357,16 @@ class ServingRuntime:
         self._eval = make_hybrid_eval_step(
             de, pred_fn, mesh=mesh, dynamic=self._streaming_cfg,
             donate_inputs=True)
+        # writer-side state lock (reentrant: the staleness/level helpers
+        # re-acquire it from already-locked callers). In realtime mode
+        # ONE runtime is driven from three threads of control — the
+        # RealtimeDriver submits/polls, the trainer installs snapshots,
+        # the mplane exporter scrapes _collect — so every host-side
+        # mutable (queue, outcome counters, freshness, ladder level)
+        # mutates under this lock. The flush device path deliberately
+        # stays OUTSIDE it: _published is read once per flush (RCU), so
+        # publication never waits on device compute and vice versa
+        self._state_lock = threading.RLock()
         self._queue: List[Request] = []
         self._queued_samples = 0
         self._level = 0
@@ -434,6 +444,14 @@ class ServingRuntime:
         g("detpu_serve_freshness_stale",
           "1 while the freshness SLO is breached").set(int(self._stale))
 
+    def _count(self, key: str, n: int = 1) -> None:
+        """Bump one outcome counter under the state lock. A bare dict
+        ``+=`` is a read-modify-write: concurrent bumps from the driver
+        and trainer threads can lose increments (the concurrency
+        auditor's first real finding in this file)."""
+        with self._state_lock:
+            self._counts[key] += n
+
     # --------------------------------------------- published table views
 
     @property
@@ -446,7 +464,9 @@ class ServingRuntime:
     @state.setter
     def state(self, value) -> None:
         _, ss, meta = self._published
-        self._published = (value, ss, meta)
+        # thread-local-ok: RCU — single-reference swap; construction /
+        # checkpoint-restore path, before any concurrent serving
+        self._published = (value, ss, meta)  # thread-local-ok: RCU swap
 
     @property
     def streaming_state(self):
@@ -456,7 +476,9 @@ class ServingRuntime:
     @streaming_state.setter
     def streaming_state(self, value) -> None:
         st, _, meta = self._published
-        self._published = (st, value, meta)
+        # thread-local-ok: RCU — single-reference swap; construction /
+        # checkpoint-restore path, before any concurrent serving
+        self._published = (st, value, meta)  # thread-local-ok: RCU swap
 
     def install_snapshot(self, state, streaming_state=None, *,
                          version: int, train_step: int,
@@ -475,24 +497,28 @@ class ServingRuntime:
         recompiles contract ``make check-online`` drills)."""
         now = self._clock() if now is None else now
         published_t = now if published_t is None else float(published_t)
-        meta = self._published[2]
-        if meta is not None and version <= meta[0]:
-            raise ValueError(
-                f"snapshot version must be monotonic: got {version}, "
-                f"installed {meta[0]}")
         if self._streaming_cfg is not None and streaming_state is None:
             raise ValueError(
                 "this runtime serves streaming tables: install_snapshot "
                 "needs the matching streaming_state copy")
-        self._published = (state, streaming_state,
-                           (int(version), int(train_step), published_t))
-        # the snapshot IS the freshest trained view at publish time
-        self._latest_train_step = int(train_step)
-        self._counts["snapshots_installed"] += 1
-        obs.counter_inc("snapshot_published")
-        obs.record_event("snapshot_published", version=int(version),
-                         train_step=int(train_step))
-        self._refresh_staleness(now)
+        with self._state_lock:
+            # the version check is a check-then-act: it and the swap
+            # must be one atom or two racing publishers could both pass
+            meta = self._published[2]
+            if meta is not None and version <= meta[0]:
+                raise ValueError(
+                    f"snapshot version must be monotonic: got {version}, "
+                    f"installed {meta[0]}")
+            self._published = (state, streaming_state,
+                               (int(version), int(train_step),
+                                published_t))
+            # the snapshot IS the freshest trained view at publish time
+            self._latest_train_step = int(train_step)
+            self._counts["snapshots_installed"] += 1
+            obs.counter_inc("snapshot_published")
+            obs.record_event("snapshot_published", version=int(version),
+                             train_step=int(train_step))
+            self._refresh_staleness(now)
 
     def note_train_step(self, step: int, now: Optional[float] = None) -> None:
         """Tell the server how far training has advanced (the freshness
@@ -502,19 +528,22 @@ class ServingRuntime:
         load is refused serve-side (typed, ``reason="stale_snapshot"``)
         before the trainer is ever blocked on publication."""
         now = self._clock() if now is None else now
-        if self._latest_train_step is None or step > self._latest_train_step:
-            self._latest_train_step = int(step)
-        self._refresh_staleness(now)
+        with self._state_lock:
+            if (self._latest_train_step is None
+                    or step > self._latest_train_step):
+                self._latest_train_step = int(step)
+            self._refresh_staleness(now)
 
     def set_freshness_slo(self, max_steps: Optional[int] = None,
                           max_s: Optional[float] = None) -> None:
         """Override the env-default freshness SLO (the online runtime
         pushes its :class:`~.online.OnlineConfig` through here so one
         config governs publisher and server)."""
-        if max_steps is not None:
-            self._freshness_max_steps = int(max_steps)
-        if max_s is not None:
-            self._freshness_max_s = float(max_s)
+        with self._state_lock:
+            if max_steps is not None:
+                self._freshness_max_steps = int(max_steps)
+            if max_s is not None:
+                self._freshness_max_s = float(max_s)
 
     def _staleness(self, now: float) -> Optional[Tuple[int, float, float]]:
         """(version, lag_steps, age_s) of the installed snapshot, or
@@ -528,35 +557,38 @@ class ServingRuntime:
         return version, max(0, latest - snap_step), max(0.0, now - pub_t)
 
     def _refresh_staleness(self, now: float) -> None:
-        st = self._staleness(now)
-        if st is None:
-            return
-        version, lag_steps, age_s = st
-        stale = ((self._freshness_max_steps > 0
-                  and lag_steps > self._freshness_max_steps)
-                 or (self._freshness_max_s > 0
-                     and age_s > self._freshness_max_s))
-        if stale and not self._stale:
-            obs.counter_inc("snapshot_lagging")
-            obs.record_event("snapshot_lagging", version=version,
-                             lag_steps=int(lag_steps),
-                             age_s=float(age_s),
-                             max_steps=self._freshness_max_steps,
-                             max_s=self._freshness_max_s)
-            logger.warning(
-                "serving snapshot v%d is STALE (%d step(s) / %.3f s "
-                "behind training) — entering the shed rung", version,
-                lag_steps, age_s)
-            rec = mplane.flight_recorder()
-            if rec is not None:
-                # freshness/SLO breach: park a post-mortem while the
-                # breach is live (the black box names the lagging
-                # version and carries the recent stats ring)
-                rec.note_stats(self.stats())
-                rec.dump("freshness_breach", version=int(version),
-                         lag_steps=int(lag_steps), age_s=float(age_s))
-        self._stale = stale
-        self._update_level()
+        # reentrant: install_snapshot/note_train_step call this with
+        # the state lock already held; poll() calls it bare
+        with self._state_lock:
+            st = self._staleness(now)
+            if st is None:
+                return
+            version, lag_steps, age_s = st
+            stale = ((self._freshness_max_steps > 0
+                      and lag_steps > self._freshness_max_steps)
+                     or (self._freshness_max_s > 0
+                         and age_s > self._freshness_max_s))
+            if stale and not self._stale:
+                obs.counter_inc("snapshot_lagging")
+                obs.record_event("snapshot_lagging", version=version,
+                                 lag_steps=int(lag_steps),
+                                 age_s=float(age_s),
+                                 max_steps=self._freshness_max_steps,
+                                 max_s=self._freshness_max_s)
+                logger.warning(
+                    "serving snapshot v%d is STALE (%d step(s) / %.3f s "
+                    "behind training) — entering the shed rung", version,
+                    lag_steps, age_s)
+                rec = mplane.flight_recorder()
+                if rec is not None:
+                    # freshness/SLO breach: park a post-mortem while the
+                    # breach is live (the black box names the lagging
+                    # version and carries the recent stats ring)
+                    rec.note_stats(self.stats())
+                    rec.dump("freshness_breach", version=int(version),
+                             lag_steps=int(lag_steps), age_s=float(age_s))
+            self._stale = stale
+            self._update_level()
 
     @property
     def freshness_stale(self) -> bool:
@@ -574,9 +606,11 @@ class ServingRuntime:
                 f"request has {len(req.cats)} categorical inputs, the "
                 f"model takes {len(self.de.strategy.input_table_map)}")
         spec = self._spec_of(req.cats, req.batch)
-        if self._input_spec is None:
-            self._input_spec, self._batch_spec = spec
-        elif spec[0] != self._input_spec:
+        with self._state_lock:
+            # first-submit initialization is a check-then-act
+            if self._input_spec is None:
+                self._input_spec, self._batch_spec = spec
+        if spec[0] != self._input_spec:
             raise ValueError(
                 f"request input spec {spec[0]} does not match the "
                 f"warmed-up spec {self._input_spec} — one compiled "
@@ -611,7 +645,7 @@ class ServingRuntime:
                 for row in c:
                     row = list(row)
                     if len(row) > hot:
-                        self._counts["ragged_clipped"] += len(row) - hot
+                        self._count("ragged_clipped", len(row) - hot)
                         row = row[:hot]
                     rows.append(row)
                 cats.append(rows)
@@ -619,8 +653,11 @@ class ServingRuntime:
                 cats.append(np.asarray(c))
         req.cats = cats
         req.n = int(n)
-        req.rid = self._next_rid
-        self._next_rid += 1
+        with self._state_lock:
+            # rid assignment must be atomic or two racing submits can
+            # share a rid (the result-matching key)
+            req.rid = self._next_rid
+            self._next_rid += 1
         req.t_submit = now
         dl = (req.deadline_ms if req.deadline_ms is not None
               else self.config.deadline_ms)
@@ -671,15 +708,16 @@ class ServingRuntime:
         elif q >= shed_at and req.priority <= 0:
             reason = "load_shed"
         if reason is not None:
-            self._counts["shed"] += 1
+            self._count("shed")
             if reason == "stale_snapshot":
-                self._counts["stale_shed"] += 1
+                self._count("stale_shed")
             obs.counter_inc("serve_shed")
             self._update_level()
             return Overloaded(rid=req.rid, latency_ms=0.0, reason=reason,
                               level=self._level, queue_samples=q)
-        self._queue.append(req)
-        self._queued_samples += req.n
+        with self._state_lock:
+            self._queue.append(req)
+            self._queued_samples += req.n
         self._qdepth_sketch.observe(self._queued_samples)
         self._update_level()
         return None
@@ -709,26 +747,32 @@ class ServingRuntime:
         return 0
 
     def _set_level(self, new: int, q: int) -> None:
-        old = self._level
-        if new == old:
-            return
-        self._level = new
-        if new > old:
-            self._counts["degraded"] += 1
-            obs.record_event("serve_degraded", level=new, from_level=old,
-                             level_name=LEVELS[new], queue_samples=q)
-            logger.warning("serving degraded to %s (queue %d samples)",
-                           LEVELS[new], q)
-        else:
-            self._counts["recovered"] += 1
-            obs.record_event("serve_recovered", level=new, from_level=old,
-                             level_name=LEVELS[new], queue_samples=q)
-            logger.info("serving recovered to %s (queue %d samples)",
-                        LEVELS[new], q)
+        # reentrant: reads-then-writes _level and fires the transition
+        # event exactly once, however many threads race the transition
+        with self._state_lock:
+            old = self._level
+            if new == old:
+                return
+            self._level = new
+            if new > old:
+                self._counts["degraded"] += 1
+                obs.record_event("serve_degraded", level=new,
+                                 from_level=old, level_name=LEVELS[new],
+                                 queue_samples=q)
+                logger.warning("serving degraded to %s (queue %d "
+                               "samples)", LEVELS[new], q)
+            else:
+                self._counts["recovered"] += 1
+                obs.record_event("serve_recovered", level=new,
+                                 from_level=old, level_name=LEVELS[new],
+                                 queue_samples=q)
+                logger.info("serving recovered to %s (queue %d samples)",
+                            LEVELS[new], q)
 
     def _update_level(self) -> None:
-        self._set_level(self._target_level(self._queued_samples),
-                        self._queued_samples)
+        with self._state_lock:
+            self._set_level(self._target_level(self._queued_samples),
+                            self._queued_samples)
 
     # ----------------------------------------------------------- packing
 
@@ -829,7 +873,9 @@ class ServingRuntime:
 
         obs.install_compile_listener()
         cats, batch = template
-        self._input_spec, self._batch_spec = self._spec_of(cats, batch)
+        # thread-local-ok: warmup precedes serving — the driver/trainer
+        # threads only start once the ladder is compiled
+        self._input_spec, self._batch_spec = self._spec_of(cats, batch)  # thread-local-ok: warmup precedes serving
         before = obs.counters().get("recompiles", 0)
         for rung in self.rungs:
             c, b, _ = self._pack([], rung)
@@ -841,9 +887,9 @@ class ServingRuntime:
                     "ignore", message="Some donated buffers were not")
                 out = self._dispatch(c, b)
             np.asarray(out)  # block: the compile must finish inside warmup
-        self.warmup_compiles = obs.counters().get("recompiles", 0) - before
-        self._compiles_at_steady = obs.counters().get("recompiles", 0)
-        self._warm = True
+        self.warmup_compiles = obs.counters().get("recompiles", 0) - before  # thread-local-ok: warmup precedes serving
+        self._compiles_at_steady = obs.counters().get("recompiles", 0)  # thread-local-ok: warmup precedes serving
+        self._warm = True  # thread-local-ok: warmup precedes serving
         return self.warmup_compiles
 
     def steady_recompiles(self) -> int:
@@ -876,13 +922,16 @@ class ServingRuntime:
         t_dev = self._clock()
         slices = [preds[o:o + r.n] for r, o in zip(reqs, offsets)]
         t1 = self._clock()
-        self._est_s = (t_dev - t0 if not self._est_s
-                       else 0.7 * self._est_s + 0.3 * (t_dev - t0))
-        n = sum(r.n for r in reqs)
-        self._pad_slots += rung - n
-        self._total_slots += rung
-        self._counts["flushes"] += 1
-        self._rung_flushes[rung] = self._rung_flushes.get(rung, 0) + 1
+        with self._state_lock:
+            # flush accounting only — the device work above ran
+            # lock-free against the RCU-read published triple
+            self._est_s = (t_dev - t0 if not self._est_s
+                           else 0.7 * self._est_s + 0.3 * (t_dev - t0))
+            n = sum(r.n for r in reqs)
+            self._pad_slots += rung - n
+            self._total_slots += rung
+            self._counts["flushes"] += 1
+            self._rung_flushes[rung] = self._rung_flushes.get(rung, 0) + 1
         # latency decomposition: the flush-level spans are shared by
         # every coalesced request (they waited on the SAME pack /
         # dispatch / device / slice work); queue wait is per request.
@@ -920,10 +969,10 @@ class ServingRuntime:
             if meta is not None:
                 self._fresh_steps_sketch.observe(stale_steps)
                 self._fresh_s_sketch.observe(stale_s)
-            self._counts["served"] += 1
-            self._counts["served_samples"] += r.n
+            self._count("served")
+            self._count("served_samples", r.n)
             if missed:
-                self._counts["deadline_missed"] += 1
+                self._count("deadline_missed")
                 obs.counter_inc("serve_deadline_missed")
             obs.counter_inc("serve_served")
             out.append(Served(rid=r.rid, latency_ms=lat,
@@ -953,18 +1002,20 @@ class ServingRuntime:
             # spend rung slots on them (strictly past: at exactly the
             # deadline the flush below still gets its chance)
             keep = []
-            for r in self._queue:
-                if r.deadline < t:
-                    self._queued_samples -= r.n
-                    self._counts["expired"] += 1
-                    self._counts["deadline_missed"] += 1
-                    obs.counter_inc("serve_deadline_missed")
-                    out.append(Expired(rid=r.rid,
-                                       latency_ms=(t - r.t_submit) * 1e3,
-                                       deadline_ms=r.deadline_ms))
-                else:
-                    keep.append(r)
-            self._queue = keep
+            with self._state_lock:
+                for r in self._queue:
+                    if r.deadline < t:
+                        self._queued_samples -= r.n
+                        self._counts["expired"] += 1
+                        self._counts["deadline_missed"] += 1
+                        obs.counter_inc("serve_deadline_missed")
+                        out.append(Expired(
+                            rid=r.rid,
+                            latency_ms=(t - r.t_submit) * 1e3,
+                            deadline_ms=r.deadline_ms))
+                    else:
+                        keep.append(r)
+                self._queue = keep
             if not self._queue:
                 break
             oldest = self._queue[0]
@@ -994,15 +1045,17 @@ class ServingRuntime:
         lose every co-batched request."""
         picked: List[Request] = []
         total = 0
-        while self._queue and total + self._queue[0].n <= self.rungs[-1]:
-            r = self._queue.pop(0)
-            picked.append(r)
-            total += r.n
-        self._queued_samples -= total
+        with self._state_lock:
+            while (self._queue
+                   and total + self._queue[0].n <= self.rungs[-1]):
+                r = self._queue.pop(0)
+                picked.append(r)
+                total += r.n
+            self._queued_samples -= total
         try:
             return self._run_flush(picked, self._rung_for(total))
         except Exception as e:  # noqa: BLE001 - typed failure, see Failed
-            self._counts["failed"] += len(picked)
+            self._count("failed", len(picked))
             obs.counter_inc("serve_failed", len(picked))
             obs.record_event("serve_flush_error", error=repr(e),
                              requests=len(picked))
@@ -1182,6 +1235,12 @@ class RealtimeDriver:
         results = drv.results()
     """
 
+    # state the driver thread and its caller both touch (detlint
+    # thread-shared): _results is guarded by _lock; submitted is
+    # written once by the driver thread at stream end and read by the
+    # caller only after join()
+    _THREAD_SHARED = ("_results", "submitted")
+
     def __init__(self, server, make_request: Callable[[int], Request],
                  qps: float, *, duration_s: Optional[float] = None,
                  burst_positions: Optional[Sequence[int]] = None,
@@ -1259,7 +1318,7 @@ class RealtimeDriver:
             wait = next_t - (self._clock() - start)
             if wait > 0:
                 time.sleep(min(0.0005, wait))  # poll tick, 0.5 ms cap
-        self.submitted = i
+        self.submitted = i  # thread-local-ok: single write by the driver thread at stream end; callers read after join()
         deadline = self._clock() + self._drain_s
         while (getattr(self._server, "queued_samples", 0)
                and self._clock() < deadline):

@@ -393,6 +393,18 @@ class Supervisor:
     seqlock write into shared memory, crash-proof by construction.
     """
 
+    # state the caller / monitor / sender threads share (detlint
+    # thread-shared): every mutation holds self._lock, or carries a
+    # thread-local-ok waiver at the site explaining why it is safe
+    # (pre-thread construction, post-join teardown, atomic reference
+    # swap by a sole writer)
+    _THREAD_SHARED = (
+        "_alive", "_closing", "_counts", "_down_reason", "_down_since",
+        "_inflight", "_last_pong", "_last_train_step", "_last_version",
+        "_next_rid", "_restarts", "_results", "_shm", "_slo", "_warm",
+        "_worker", "_worker_stats", "restart_budget_exhausted",
+    )
+
     def __init__(self, factory: str, kwargs: Optional[Dict[str, Any]] = None,
                  *, config: Optional[SuperviseConfig] = None,
                  clock: Callable[[], float] = time.monotonic):
@@ -494,7 +506,7 @@ class Supervisor:
         background."""
         if self._monitor is not None:
             raise RuntimeError("supervisor already started")
-        self._worker = self._spawn_worker()
+        self._worker = self._spawn_worker()  # thread-local-ok: runs before the monitor/sender threads exist
         self._on_worker_up()
         self._sender = threading.Thread(target=self._send_loop,
                                         name="detpu-supervise-send",
@@ -514,8 +526,9 @@ class Supervisor:
             self._last_pong = now
             if self._restarts:
                 self._awaiting_first_served = now
-        if self._last_train_step is not None:
-            self._send_q.put(("train_step", self._last_train_step))
+            step = self._last_train_step
+        if step is not None:
+            self._send_q.put(("train_step", step))
 
     # ----------------------------------------------------- wire plumbing
 
@@ -553,7 +566,8 @@ class Supervisor:
                         (now - self._awaiting_first_served) * 1e3)
                     self._awaiting_first_served = None
         elif kind == "stats_reply":
-            self._worker_stats = msg[1]
+            with self._lock:
+                self._worker_stats = msg[1]
             self._stats_event.set()
         elif kind == "worker_error":
             logger.error("serving worker raised:\n%s", msg[1])
@@ -589,8 +603,8 @@ class Supervisor:
 
     def _on_worker_down(self, reason: str) -> None:
         now = self._clock()
-        worker, self._worker = self._worker, None
         with self._lock:
+            worker, self._worker = self._worker, None
             self._alive = False
             self._down_since = now
             self._down_reason = f"worker_{reason}"
@@ -637,8 +651,8 @@ class Supervisor:
         attempt = 0
         while not self._closing:
             if self._restarts >= self.cfg.max_restarts:
-                self.restart_budget_exhausted = True
                 with self._lock:
+                    self.restart_budget_exhausted = True
                     self._down_reason = "restart_budget_exhausted"
                 logger.error("serving worker restart budget (%d) "
                              "exhausted; serving stays Unavailable",
@@ -651,9 +665,12 @@ class Supervisor:
             delay *= 0.5 + random.random()
             time.sleep(delay)
             attempt += 1
-            self._restarts += 1
+            with self._lock:
+                self._restarts += 1
             try:
-                self._worker = self._spawn_worker()
+                # spawn outside the lock (blocks on fork + accept +
+                # worker warmup); the reference swap itself is atomic
+                self._worker = self._spawn_worker()  # thread-local-ok: reference swap by the monitor thread, the sole writer while supervision runs
             except Exception as e:  # noqa: BLE001 - spawn/ready failure
                 # burns budget and backs off further, never raises into
                 # the trainer
@@ -689,24 +706,33 @@ class Supervisor:
                 f"published {self._last_version}")
         t0 = self._clock()
         payload = snapshot_payload(state, streaming_state)
-        if self._shm is None:
-            self._shm = shm_mod.SnapshotShm.create(
-                shm_mod.slack_capacity(len(payload)))
-            self._send_q.put(("shm", self._shm.name))
+        created = None
+        with self._lock:
+            # lazy region creation is a check-then-act; _spawn_spec
+            # reads _shm from the monitor thread on every restart
+            if self._shm is None:
+                self._shm = shm_mod.SnapshotShm.create(
+                    shm_mod.slack_capacity(len(payload)))
+                created = self._shm.name
+        if created is not None:
+            self._send_q.put(("shm", created))
         wall = time.monotonic() if published_t is None else published_t
         self._shm.publish_bytes(payload, version=int(version),
                                 train_step=int(train_step), wall_ts=wall)
         self._publish_ms.observe((self._clock() - t0) * 1e3)
-        self._last_version = int(version)
-        self._last_train_step = int(train_step)
+        with self._lock:
+            self._last_version = int(version)
+            self._last_train_step = int(train_step)
 
     def note_train_step(self, step: int) -> None:
-        self._last_train_step = int(step)
+        with self._lock:
+            self._last_train_step = int(step)
         self._send_q.put(("train_step", int(step)))
 
     def set_freshness_slo(self, steps: Optional[float] = None,
                           seconds: Optional[float] = None) -> None:
-        self._slo = (steps, seconds)
+        with self._lock:
+            self._slo = (steps, seconds)
         self._send_q.put(("slo", steps, seconds))
 
     def warmup(self, template=None) -> None:
@@ -792,7 +818,7 @@ class Supervisor:
         owns it — last one out)."""
         # stop supervision FIRST: the monitor must not read the orderly
         # exit below as a crash (and burn a restart + a black box on it)
-        self._closing = True
+        self._closing = True  # thread-local-ok: atomic stop flag, sole writer; the loops poll it
         if self._monitor is not None:
             self._monitor.join(timeout=5)
         if self._sender is not None:
@@ -807,12 +833,12 @@ class Supervisor:
         if worker is not None:
             worker.kill()
             worker.close()
-        self._worker = None
-        self._alive = False
+        self._worker = None  # thread-local-ok: monitor/sender joined above, no other thread of control remains
+        self._alive = False  # thread-local-ok: monitor/sender joined above, no other thread of control remains
         try:
             self._listener.close()
         except Exception:  # noqa: BLE001 - already closed
             pass
         if self._shm is not None:
             self._shm.unlink()
-            self._shm = None
+            self._shm = None  # thread-local-ok: monitor/sender joined above, no other thread of control remains

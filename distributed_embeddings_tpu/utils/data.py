@@ -137,6 +137,11 @@ class RawBinaryDataset:
         the early batches with a late-step LR (ADVICE r4).
     """
 
+    # detlint thread-shared: the prefetch producer spawned per
+    # iteration touches only its closure locals plus the synchronized
+    # queue/stop-event pair — no instance attribute is shared with it
+    _THREAD_SHARED = ()
+
     def __init__(self, data_path: str, batch_size: int = 1,
                  numerical_features: int = 0,
                  categorical_features: Optional[Sequence[int]] = None,

@@ -321,6 +321,21 @@ declare("DETPU_SUPERVISE_START_TIMEOUT_S", default="300",
             "warmup and report ready; a worker that blows it is treated "
             "as crashed (kill + backoff + next attempt)")
 
+# concurrency auditor: lock-discipline analysis + interleaving model
+# checker over the serving plane (analysis/concurrency_audit.py +
+# tools/concurrency_audit.py = make concurrency-audit)
+declare("DETPU_CONCURRENCY_DEPTH", default="8",
+        doc="virtual-clock tick bound of the supervisor heartbeat model "
+            "explored by make concurrency-audit: larger values widen "
+            "the interleaving space (more crash/restart phases per "
+            "proof) at exponential state cost; 8 covers two full "
+            "fault -> detect -> restart -> re-ingest cycles")
+declare("DETPU_CONCURRENCY_WORDS", default="2",
+        doc="payload words in the seqlock interleaving model: each word "
+            "is an independently-timed copy step, so more words = more "
+            "distinct torn prefixes the explorer must prove detected; "
+            "2 already exhibits every mix class (old/new, new/old)")
+
 # non-finite guard (utils/obs.py + parallel/trainer.py + resilient.py)
 declare("DETPU_NANGUARD", default="1",
         doc="on-device non-finite guard in the hybrid step; 0 = build the "

@@ -234,7 +234,7 @@ class QuantileSketch:
         first, second = ((self, other) if id(self) <= id(other)
                          else (other, self))
         with first._lock:
-            with second._lock if first is not second else _NULL_CTX:
+            with second._lock if first is not second else _NULL_CTX:  # lock-order-ok: id-ordered acquisition (first/second sorted by id above) — both orders converge on one global order
                 for i, n in other.buckets.items():
                     self.buckets[i] = self.buckets.get(i, 0) + n
                 self.zero_count += other.zero_count

@@ -303,6 +303,12 @@ def drain_events(kind: Optional[str] = None) -> List[Dict[str, Any]]:
 
 
 _compile_listener_installed = False
+# guards the install check-then-act: two threads warming two serving
+# runtimes (the online drill's trainer + server) could otherwise both
+# pass the installed check and double-register the listener — every
+# recompile would then count twice and the 0-steady-state-recompiles
+# gates would flag phantom retraces
+_compile_lock = threading.Lock()
 
 # one backend compile per jitted-signature miss: cache hits do not fire it
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
@@ -320,23 +326,26 @@ def install_compile_listener() -> bool:
     nothing else).
     """
     global _compile_listener_installed
-    if _compile_listener_installed:
+    with _compile_lock:
+        if _compile_listener_installed:
+            return True
+        try:
+            import jax.monitoring
+        except Exception:  # noqa: BLE001 - counter is best-effort
+            return False
+        if not hasattr(jax.monitoring,
+                       "register_event_duration_secs_listener"):
+            return False
+
+        def _on_duration(event: str, duration: float,
+                         **kwargs: Any) -> None:
+            del duration, kwargs
+            if event == _COMPILE_EVENT:
+                counter_inc("recompiles")
+
+        jax.monitoring.register_event_duration_secs_listener(_on_duration)
+        _compile_listener_installed = True
         return True
-    try:
-        import jax.monitoring
-    except Exception:  # noqa: BLE001 - counter is best-effort
-        return False
-    if not hasattr(jax.monitoring, "register_event_duration_secs_listener"):
-        return False
-
-    def _on_duration(event: str, duration: float, **kwargs: Any) -> None:
-        del duration, kwargs
-        if event == _COMPILE_EVENT:
-            counter_inc("recompiles")
-
-    jax.monitoring.register_event_duration_secs_listener(_on_duration)
-    _compile_listener_installed = True
-    return True
 
 
 # --------------------------------------------------------- host collection

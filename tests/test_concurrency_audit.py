@@ -1,0 +1,344 @@
+"""The concurrency auditor (analysis/concurrency_audit.py) — both halves.
+
+Half 1 (lock-discipline AST analysis): every finding kind fires on a
+seeded-broken snippet and stays quiet on its fixed/waived twin; thread
+discovery sees constructor spawns, Thread subclasses, and closure
+producers; the `_THREAD_SHARED` declaration is enforced and
+cross-checked; and the repo itself audits clean under the reference
+contracts (the dogfood gate — the same scan `make concurrency-audit`
+runs).
+
+Half 2 (interleaving model checker): the faithful seqlock and
+supervisor models PROVE their invariants over the full bounded
+interleaving space, and all three seeded mutants are REFUTED by the
+*intended* invariant with a concrete counterexample trace.
+
+Pure stdlib — no jax anywhere in the module under test.
+"""
+
+import subprocess
+import sys
+import os
+
+import pytest
+
+from distributed_embeddings_tpu.analysis import concurrency_audit as ca
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _kinds(report):
+    return {f.kind for f in report.findings}
+
+
+# ================================================== Half 1: lock discipline
+
+
+def test_drill_unguarded_shared_fires():
+    rep = ca.audit_source(ca.DRILL_UNGUARDED_SRC, "<t>")
+    assert "unguarded-shared" in _kinds(rep)
+    # both the spawned loop and the caller-thread bump mutate _count
+    f = next(f for f in rep.findings if f.kind == "unguarded-shared")
+    assert "_count" in f.message
+
+
+def test_guarded_twin_is_quiet():
+    src = ca.DRILL_UNGUARDED_SRC.replace(
+        "        while True:\n"
+        "            self._count += 1",
+        "        while True:\n"
+        "            with self._lock:\n"
+        "                self._count += 1"
+    ).replace(
+        "    def bump(self):\n"
+        "        self._count += 1",
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self._count += 1")
+    rep = ca.audit_source(src, "<t>")
+    assert "unguarded-shared" not in _kinds(rep)
+
+
+def test_thread_local_ok_waiver_silences():
+    src = ca.DRILL_UNGUARDED_SRC.replace(
+        "            self._count += 1",
+        "            self._count += 1  # thread-local-ok: test waiver")
+    # the caller-side bump() mutation is still unwaived -> still fires;
+    # waive both sites and the finding disappears
+    rep = ca.audit_source(src, "<t>")
+    assert "unguarded-shared" in _kinds(rep)
+    src = src.replace(
+        "        self._count += 1",
+        "        self._count += 1  # thread-local-ok: test waiver")
+    rep = ca.audit_source(src, "<t>")
+    assert "unguarded-shared" not in _kinds(rep)
+
+
+def test_mutation_in_init_is_exempt():
+    """Construction happens-before the spawn — __init__ writes are not
+    cross-thread mutations."""
+    src = ("import threading\n"
+           "class W:\n"
+           "    def __init__(self):\n"
+           "        self._n = 0\n"
+           "        threading.Thread(target=self._loop).start()\n"
+           "    def _loop(self):\n"
+           "        print(self._n)\n")
+    rep = ca.audit_source(src, "<t>")
+    assert "unguarded-shared" not in _kinds(rep)
+
+
+def test_drill_lock_order_cycle_fires_and_waives():
+    rep = ca.audit_source(ca.DRILL_CYCLE_SRC, "<t>")
+    assert "lock-order-cycle" in _kinds(rep)
+    assert rep.cycles  # the cycle itself is reported on the report too
+    waived = ca.DRILL_CYCLE_SRC.replace(
+        "            with self._a:",
+        "            with self._a:  # lock-order-ok: test waiver")
+    rep = ca.audit_source(waived, "<t>")
+    assert "lock-order-cycle" not in _kinds(rep)
+
+
+def test_consistent_order_is_quiet():
+    src = ca.DRILL_CYCLE_SRC.replace(
+        "    def ba(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n",
+        "    def ba(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n")
+    rep = ca.audit_source(src, "<t>")
+    assert "lock-order-cycle" not in _kinds(rep)
+    # the a->b edge is still recorded (order analysis ran)
+    assert any(a.endswith("._a") and b.endswith("._b")
+               for (a, b) in rep.lock_edges)
+
+
+def test_rlock_self_reacquisition_is_not_a_cycle():
+    """A locked caller calling a helper that re-acquires the same RLock
+    is reentrant re-acquisition, not a deadlock — the serving.py
+    _state_lock discipline."""
+    src = ("import threading\n"
+           "class S:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.RLock()\n"
+           "        self._n = 0\n"
+           "    def _bump(self):\n"
+           "        with self._lock:\n"
+           "            self._n += 1\n"
+           "    def submit(self):\n"
+           "        with self._lock:\n"
+           "            self._bump()\n")
+    rep = ca.audit_source(src, "<t>")
+    assert "lock-order-cycle" not in _kinds(rep)
+    # the same shape on a plain Lock IS a self-deadlock
+    rep = ca.audit_source(src.replace("RLock", "Lock"), "<t>")
+    assert "lock-order-cycle" in _kinds(rep)
+
+
+def test_drill_blocking_under_lock_fires_and_waives():
+    rep = ca.audit_source(ca.DRILL_BLOCKING_SRC, "<t>")
+    found = [f for f in rep.findings if f.kind == "blocking-under-lock"]
+    assert found and "time.sleep" in found[0].message
+    waived = ca.DRILL_BLOCKING_SRC.replace(
+        "            time.sleep(0.1)",
+        "            time.sleep(0.1)  # blocking-ok: test waiver")
+    rep = ca.audit_source(waived, "<t>")
+    assert "blocking-under-lock" not in _kinds(rep)
+
+
+def test_blocking_bubbles_through_calls():
+    """A locked caller invoking a method that blocks is the same hazard
+    one hop removed — the interprocedural may-block pass."""
+    src = ("import threading, time\n"
+           "class P:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "    def _slow(self):\n"
+           "        time.sleep(1)\n"
+           "    def poll(self):\n"
+           "        with self._lock:\n"
+           "            self._slow()\n")
+    rep = ca.audit_source(src, "<t>")
+    assert "blocking-under-lock" in _kinds(rep)
+
+
+def test_timeout_bounded_calls_are_not_blocking():
+    src = ("import threading\n"
+           "class P:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._q = None\n"
+           "    def poll(self):\n"
+           "        with self._lock:\n"
+           "            return self._q.get(timeout=0.1)\n")
+    rep = ca.audit_source(src, "<t>")
+    assert "blocking-under-lock" not in _kinds(rep)
+
+
+def test_closure_producer_is_a_thread_of_control():
+    """data.py idiom: a nested def handed to Thread(target=...) inside a
+    method is its own thread of control, and instance attributes it
+    mutates count as cross-thread."""
+    src = ("import threading\n"
+           "class DS:\n"
+           "    def __init__(self):\n"
+           "        self.n = 0\n"
+           "    def run(self):\n"
+           "        def producer():\n"
+           "            self.n += 1\n"
+           "        threading.Thread(target=producer).start()\n"
+           "        self.n += 1\n")
+    rep = ca.audit_source(src, "<t>")
+    assert "unguarded-shared" in _kinds(rep)
+
+
+def test_thread_shared_declaration_is_enforced():
+    """A declared _THREAD_SHARED attr is held to the guard discipline
+    even if discovery alone wouldn't see two mutating threads; a
+    declared name that doesn't exist is contract drift."""
+    src = ("import threading\n"
+           "class D:\n"
+           "    _THREAD_SHARED = ('_x',)\n"
+           "    def __init__(self):\n"
+           "        self._x = 0\n"
+           "        threading.Thread(target=self._loop).start()\n"
+           "    def _loop(self):\n"
+           "        pass\n"
+           "    def bump(self):\n"
+           "        self._x += 1\n")
+    rep = ca.audit_source(src, "<t>")
+    assert "unguarded-shared" in _kinds(rep)
+    ghost = src.replace("('_x',)", "('_x', '_ghost')")
+    rep = ca.audit_source(ghost, "<t>")
+    assert "contract-drift" in _kinds(rep)
+
+
+def test_contract_drift_both_directions():
+    src = ("import threading\n"
+           "class W:\n"
+           "    _THREAD_SHARED = ()\n"
+           "    def start(self):\n"
+           "        threading.Thread(target=self._loop).start()\n"
+           "    def _loop(self):\n"
+           "        pass\n")
+    # spawning module with no contract at all -> drift
+    rep = ca.audit_source(src, "<t>")
+    assert "contract-drift" in _kinds(rep)
+    # contract listing exactly the discovered thread -> clean
+    c = ca.ConcurrencyContract(module="<t>", threads=("W._loop",))
+    rep = ca.audit_source(src, "<t>", contract=c)
+    assert "contract-drift" not in _kinds(rep)
+    # contract naming a thread that no longer exists -> drift
+    c = ca.ConcurrencyContract(module="<t>",
+                               threads=("W._loop", "W._gone"))
+    rep = ca.audit_source(src, "<t>", contract=c)
+    assert "contract-drift" in _kinds(rep)
+
+
+def test_watched_global_mutation_requires_module_lock():
+    src = ("import threading\n"
+           "_lock = threading.Lock()\n"
+           "_counters = {}\n"
+           "def bump(k):\n"
+           "    _counters[k] = _counters.get(k, 0) + 1\n")
+    c = ca.ConcurrencyContract(module="<t>", threads=(),
+                               shared_globals=("_counters",))
+    rep = ca.audit_source(src, "<t>", contract=c)
+    assert "global-unguarded" in _kinds(rep)
+    guarded = src.replace(
+        "    _counters[k] = _counters.get(k, 0) + 1",
+        "    with _lock:\n"
+        "        _counters[k] = _counters.get(k, 0) + 1")
+    rep = ca.audit_source(guarded, "<t>", contract=c)
+    assert "global-unguarded" not in _kinds(rep)
+
+
+def test_repo_audits_clean_under_reference_contracts():
+    """Dogfood: the serving plane ships with zero unwaived findings and
+    an acyclic lock-order graph (the make concurrency-audit gate)."""
+    rep = ca.audit_repo()
+    assert rep.findings == [], "\n".join(str(f) for f in rep.findings)
+    assert rep.cycles == []
+    # the contracted inventory is discovered, not asserted into being
+    assert "parallel/supervisor.py" in rep.inventory
+    assert "parallel/serving.py" in rep.inventory
+    assert "utils/data.py" in rep.inventory
+    assert rep.modules > 40
+
+
+def test_report_round_trips_to_dict():
+    rep = ca.audit_source(ca.DRILL_CYCLE_SRC, "<t>")
+    d = rep.to_dict()
+    assert d["modules"] == 1
+    assert any(f["kind"] == "lock-order-cycle" for f in d["findings"])
+    assert d["cycles"]
+
+
+# ================================================ Half 2: model checking
+
+
+def test_seqlock_faithful_proves():
+    res = ca.prove(ca.seqlock_model())
+    assert res.ok, str(res)
+    assert res.states > 100 and res.transitions > res.states
+    assert "PROVED" in str(res)
+
+
+def test_seqlock_no_crc_mutant_refuted_by_torn_read():
+    res = ca.refute(ca.seqlock_model("no_crc"))
+    assert not res.ok
+    assert res.violated == "no-torn-accept"
+    assert res.trace  # a concrete interleaving, not just "violated"
+
+
+def test_seqlock_stamps_swapped_mutant_refuted():
+    res = ca.refute(ca.seqlock_model("stamps_swapped"))
+    assert not res.ok
+    assert res.violated == "stamp-honesty"
+
+
+def test_supervisor_faithful_proves():
+    res = ca.prove(ca.supervisor_model())
+    assert res.ok, str(res)
+    assert res.states > 1000
+
+
+def test_supervisor_deadline_mutant_refuted():
+    res = ca.refute(ca.supervisor_model("deadline_off_by_one"))
+    assert not res.ok
+    assert res.violated == "hang-detected-within-deadline"
+    assert "hang" in res.trace
+
+
+def test_unknown_mutant_rejected():
+    with pytest.raises(ValueError):
+        ca.seqlock_model("not_a_mutant")
+    with pytest.raises(ValueError):
+        ca.supervisor_model("not_a_mutant")
+
+
+def test_explore_bounds_state_space():
+    with pytest.raises(RuntimeError, match="exceeded"):
+        ca.explore(ca.supervisor_model(), max_states=50)
+
+
+def test_run_drills_all_green():
+    assert ca.run_drills() == []
+
+
+# ======================================================== the CLI gate
+
+
+def test_cli_strict_green():
+    """End-to-end: the exact invocation make concurrency-audit runs."""
+    r = subprocess.run(
+        [sys.executable, "tools/concurrency_audit.py", "--strict"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "concurrency_audit: OK" in r.stdout
+    assert "PROVED" in r.stdout
+    assert "refuted" in r.stdout.lower()

@@ -1,0 +1,145 @@
+"""The RawBinaryDataset prefetch producer's lifecycle contract.
+
+The producer is a per-iteration daemon thread feeding a bounded queue
+(utils/data.py _iter_range). Three things must hold or long-running
+drivers leak:
+
+* a consumer that ABANDONS the generator mid-epoch (break / GC / driver
+  crash) must stop the producer promptly — the stop event, not queue
+  starvation, ends it;
+* repeated iterations must not accumulate orphaned daemon threads;
+* a producer-side exception (truncated file, transient IO error) must
+  surface in the CONSUMER as that exception, not hang the consumer on
+  an empty queue.
+
+The concurrency auditor's discovery side sees this thread too
+(RawBinaryDataset._iter_range:producer in the utils/data.py contract);
+these tests pin the runtime behavior the contract describes.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_embeddings_tpu.utils.data import RawBinaryDataset
+
+
+N_ROWS = 64
+BATCH = 4
+
+
+@pytest.fixture
+def dataset_dir(tmp_path):
+    """A tiny but real split-binary layout (memmaps need files)."""
+    train = tmp_path / "train"
+    train.mkdir()
+    rng = np.random.default_rng(0)
+    (train / "label.bin").write_bytes(
+        (rng.random(N_ROWS) < 0.5).astype(np.bool_).tobytes())
+    (train / "numerical.bin").write_bytes(
+        rng.random((N_ROWS, 2)).astype(np.float16).tobytes())
+    (train / "cat_0.bin").write_bytes(
+        rng.integers(0, 100, N_ROWS).astype(np.int8).tobytes())
+    return str(tmp_path)
+
+
+def _make(dataset_dir, **kw):
+    kw.setdefault("batch_size", BATCH)
+    kw.setdefault("numerical_features", 2)
+    kw.setdefault("categorical_features", [0])
+    kw.setdefault("categorical_feature_sizes", [100])
+    kw.setdefault("prefetch_depth", 4)
+    return RawBinaryDataset(dataset_dir, **kw)
+
+
+def _producer_threads():
+    return [t for t in threading.enumerate() if t.name.startswith("Thread-")
+            and t.daemon and t.is_alive()]
+
+
+def _wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+def test_full_epoch_matches_direct_reads(dataset_dir):
+    """Baseline: the threaded path yields exactly the direct reads."""
+    ds = _make(dataset_dir)
+    got = list(ds)
+    assert len(got) == len(ds) == N_ROWS // BATCH
+    for i, (num, cats, lab) in enumerate(got):
+        dnum, dcats, dlab = ds[i]
+        np.testing.assert_array_equal(num, dnum)
+        np.testing.assert_array_equal(lab, dlab)
+        for c, dc in zip(cats, dcats):
+            np.testing.assert_array_equal(c, dc)
+
+
+def test_abandoned_consumer_stops_producer(dataset_dir):
+    """Closing the generator after one batch must end the producer via
+    the stop event, even though the bounded queue is full and it would
+    otherwise block on put() forever."""
+    # depth 2 << 16 batches: the producer is certainly parked on a full
+    # queue when the consumer walks away
+    ds = _make(dataset_dir, prefetch_depth=2)
+    before = set(id(t) for t in _producer_threads())
+    it = iter(ds)
+    next(it)
+    spawned = [t for t in _producer_threads() if id(t) not in before]
+    assert len(spawned) == 1
+    it.close()  # generator finally -> stop.set()
+    assert _wait_for(lambda: not spawned[0].is_alive()), \
+        "producer still alive after consumer abandoned the iterator"
+
+
+def test_no_thread_growth_across_repeated_iterations(dataset_dir):
+    """Partial epochs in a loop (the realtime driver's shape) must not
+    accumulate daemon threads."""
+    ds = _make(dataset_dir, prefetch_depth=2)
+    baseline = threading.active_count()
+    for _ in range(10):
+        it = iter(ds)
+        next(it)
+        it.close()
+    assert _wait_for(lambda: threading.active_count() <= baseline), (
+        f"thread growth: {threading.active_count()} alive vs "
+        f"baseline {baseline}: {threading.enumerate()}")
+
+
+def test_producer_exception_surfaces_to_consumer(dataset_dir):
+    """A mid-epoch read failure must raise in the consumer, not strand
+    it on q.get()."""
+    ds = _make(dataset_dir)
+    real_read = ds._read
+
+    def flaky(idx):
+        if idx == 3:
+            raise OSError("simulated truncated read")
+        return real_read(idx)
+
+    ds._read = flaky
+    it = iter(ds)
+    got = [next(it) for _ in range(3)]
+    assert len(got) == 3
+    with pytest.raises(OSError, match="simulated truncated read"):
+        next(it)
+    # and the producer is gone afterwards
+    assert _wait_for(
+        lambda: all(not t.is_alive() or not t.name.startswith("Thread-")
+                    for t in threading.enumerate()
+                    if t.daemon and t.name.startswith("Thread-")))
+
+
+def test_unthreaded_path_when_depth_too_small(dataset_dir):
+    """prefetch_depth <= 1 takes the synchronous path — no thread at
+    all (the auditor's inventory only lists the threaded producer)."""
+    ds = _make(dataset_dir, prefetch_depth=1)
+    before = threading.active_count()
+    assert len(list(ds)) == N_ROWS // BATCH
+    assert threading.active_count() == before

@@ -17,7 +17,8 @@ from tools import detlint
 from tools.detlint.rules import (bare_except, donated_aux, eager_backend,
                                  env_registry, hardcoded_capacity,
                                  host_fetch, module_scope_jax, named_scope,
-                                 spawn_context, unsized_unique)
+                                 spawn_context, thread_shared,
+                                 unsized_unique)
 
 CTX = {"repo": detlint.REPO}
 PARALLEL = "distributed_embeddings_tpu/parallel/x.py"
@@ -249,11 +250,69 @@ def test_spawn_context_rule():
         spawn_context.SCOPE)
 
 
+def test_thread_shared_rule():
+    """Seeded drill: a thread-spawning class without a _THREAD_SHARED
+    declaration fires; the declared twin, the empty-tuple declaration,
+    the waiver, spawn-free classes, and module-level spawns stay quiet."""
+    spawning = ("import threading\n"
+                "class Driver:\n"
+                "    def start(self):\n"
+                "        threading.Thread(target=self._run).start()\n")
+    found = _check(thread_shared, spawning)
+    assert found and "_THREAD_SHARED" in found[0].message
+    # Thread subclasses spawn themselves — same obligation
+    assert _check(thread_shared,
+                  "from threading import Thread\n"
+                  "class W(Thread):\n"
+                  "    def run(self):\n"
+                  "        pass\n")
+    # a non-tuple declaration is its own finding (the auditor parses it)
+    bad_decl = ("import threading\n"
+                "class Driver:\n"
+                "    _THREAD_SHARED = ['_x']\n"
+                "    def start(self):\n"
+                "        threading.Thread(target=self._run).start()\n")
+    found = _check(thread_shared, bad_decl)
+    assert found and "literal tuple" in found[0].message
+    # the declared twin (non-empty and empty both count)
+    assert not _check(thread_shared,
+                      "import threading\n"
+                      "class Driver:\n"
+                      '    _THREAD_SHARED = ("_results",)\n'
+                      "    def start(self):\n"
+                      "        threading.Thread(target=self._run).start()\n")
+    assert not _check(thread_shared,
+                      "import threading\n"
+                      "class Driver:\n"
+                      "    _THREAD_SHARED = ()\n"
+                      "    def start(self):\n"
+                      "        threading.Thread(target=self._run).start()\n")
+    # the waiver on the spawn line
+    assert not _check(thread_shared,
+                      "import threading\n"
+                      "class Driver:\n"
+                      "    def start(self):\n"
+                      "        threading.Thread(target=f).start()"
+                      "  # thread-shared-ok: script helper\n")
+    # spawn-free classes and module-level spawns carry no obligation
+    assert not _check(thread_shared,
+                      "import threading\n"
+                      "class Plain:\n"
+                      "    pass\n"
+                      "threading.Thread(target=f).start()\n")
+    # scoped to the package; tests/tools may spawn undeclared
+    assert detlint._matches(
+        "distributed_embeddings_tpu/parallel/serving.py",
+        thread_shared.SCOPE)
+    assert not detlint._matches("tests/test_shm.py", thread_shared.SCOPE)
+    assert not detlint._matches("tools/x.py", thread_shared.SCOPE)
+
+
 def test_discover_rules_finds_all():
     rules = detlint.discover_rules()
     assert {"bare-except", "eager-backend", "env-registry",
             "hardcoded-capacity", "host-fetch", "module-scope-jax",
-            "named-scope-exchange", "spawn-context",
+            "named-scope-exchange", "spawn-context", "thread-shared",
             "unsized-unique"} <= set(rules)
 
 

@@ -1,0 +1,187 @@
+"""Regression tests for the races the concurrency auditor flagged.
+
+Each test here pins a specific dogfood fix from the lock-discipline
+audit (analysis/concurrency_audit.py) at runtime — the static gate
+proves the guard EXISTS, these prove it does what the finding said was
+broken without it:
+
+* ServingRuntime outcome counters were bare dict ``+=`` (a lost-update
+  read-modify-write) bumped from the driver, trainer, and exporter
+  threads — now ``_count()`` under the state RLock;
+* ``install_snapshot``'s version check-then-act and the published-triple
+  swap raced concurrent publishers — now one atom under the lock;
+* Supervisor caller-side mutators (``note_train_step`` /
+  ``set_freshness_slo``) write fields the monitor thread reads on the
+  crash path — now locked, with the queue put outside the lock (the
+  blocking-under-lock rule);
+* ``obs.install_compile_listener``'s idempotence flag was an unlocked
+  check-then-act: two racing callers could both register, double-
+  counting every recompile forever — now under ``_compile_lock``.
+
+All hammer tests use barriers so every thread is actually in the
+critical region together; counts are exact, not statistical.
+"""
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_embeddings_tpu.parallel import (
+    DistributedEmbedding, ServeConfig, ServingRuntime, SparseSGD,
+    init_hybrid_state)
+from distributed_embeddings_tpu.parallel.supervisor import Supervisor
+from distributed_embeddings_tpu.utils import obs
+
+import jax
+
+
+def _pred_fn(dp, outs, batch):
+    p = sum(jnp.sum(o, -1) for o in outs)
+    if batch is not None:
+        p = p + jnp.sum(batch, -1)
+    return p
+
+
+@pytest.fixture(scope="module")
+def runtime_factory():
+    """One cheap world-1 embedding/state pair shared by the module; each
+    test gets a fresh runtime over it (counters start at zero)."""
+    configs = [{"input_dim": 40, "output_dim": 4}]
+    de = DistributedEmbedding(configs, world_size=1)
+    tx = optax.sgd(0.1)
+    state = init_hybrid_state(de, SparseSGD(), {"w": jnp.ones((4, 1))},
+                              tx, jax.random.key(0))
+
+    def make():
+        return state, ServingRuntime(
+            de, _pred_fn, state, config=ServeConfig(max_batch=8))
+
+    return make
+
+
+def _hammer(n_threads, fn):
+    """Run fn(i) on n_threads, all released together; re-raise the
+    first worker exception."""
+    barrier = threading.Barrier(n_threads)
+    errors = []
+
+    def work(i):
+        barrier.wait()
+        try:
+            fn(i)
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    ts = [threading.Thread(target=work, args=(i,))
+          for i in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in ts)
+    return errors
+
+
+def test_serving_counters_lose_no_increments(runtime_factory):
+    """8 threads x 2000 bumps must land exactly — the lost-update
+    regression (unguarded `self._counts[k] += 1`)."""
+    _, rt = runtime_factory()
+    per = 2000
+
+    def bump(i):
+        for _ in range(per):
+            rt._count("served")
+            rt._count("served_samples", 3)
+
+    assert _hammer(8, bump) == []
+    assert rt._counts["served"] == 8 * per
+    assert rt._counts["served_samples"] == 8 * per * 3
+
+
+def test_install_snapshot_version_check_is_atomic(runtime_factory):
+    """8 publishers racing the SAME version: exactly one wins, the rest
+    get the monotonicity ValueError — without the lock the check-then-
+    act admits several and the installed count drifts."""
+    state, rt = runtime_factory()
+    wins, losses = [], []
+
+    def publish(i):
+        try:
+            rt.install_snapshot(state, version=1, train_step=0, now=0.0)
+            wins.append(i)
+        except ValueError:
+            losses.append(i)
+
+    assert _hammer(8, publish) == []
+    assert len(wins) == 1 and len(losses) == 7
+    assert rt._counts["snapshots_installed"] == 1
+    assert rt._published[2][0] == 1
+
+
+def test_publisher_vs_freshness_notes_stay_consistent(runtime_factory):
+    """One thread publishes monotone snapshots while another advances
+    the trainer's step note: no exception, the final freshness view is
+    the newest of both writers (not a torn mix)."""
+    state, rt = runtime_factory()
+    n = 200
+
+    def run(i):
+        if i == 0:
+            for v in range(1, n + 1):
+                rt.install_snapshot(state, version=v, train_step=v,
+                                    now=float(v))
+        else:
+            for s in range(1, n + 1):
+                rt.note_train_step(s, now=float(s))
+
+    assert _hammer(2, run) == []
+    assert rt._counts["snapshots_installed"] == n
+    assert rt._published[2][:2] == (n, n)
+    # latest_train_step is whichever writer ran last — but never behind
+    # the installed snapshot's step and never past n
+    assert n == rt._latest_train_step
+
+
+def test_supervisor_caller_mutators_are_locked():
+    """note_train_step / set_freshness_slo from many caller threads:
+    every message reaches the send queue (the worker's view) and the
+    retained fields (what a restart re-pushes) hold a written value."""
+    sup = Supervisor("tools.isolation_common:worker_factory")
+    try:
+        per = 200
+
+        def drive(i):
+            for s in range(per):
+                sup.note_train_step(i * per + s)
+                sup.set_freshness_slo(steps=float(i), seconds=None)
+
+        assert _hammer(4, drive) == []
+        msgs = []
+        while not sup._send_q.empty():
+            msgs.append(sup._send_q.get_nowait())
+        assert len(msgs) == 4 * per * 2  # nothing lost, nothing doubled
+        assert sup._last_train_step in {i * per + (per - 1)
+                                        for i in range(4)}
+        assert sup._slo in {(float(i), None) for i in range(4)}
+    finally:
+        sup.close()
+
+
+def test_compile_listener_registers_exactly_once(monkeypatch):
+    """16 racing installers, one registration — the check-then-act now
+    holds _compile_lock, so recompiles can never double-count."""
+    jm = pytest.importorskip("jax.monitoring")
+    registered = []
+    monkeypatch.setattr(jm, "register_event_duration_secs_listener",
+                        registered.append)
+    monkeypatch.setattr(obs, "_compile_listener_installed", False)
+
+    results = []
+    assert _hammer(
+        16, lambda i: results.append(obs.install_compile_listener())) == []
+    assert results == [True] * 16
+    assert len(registered) == 1
+    assert obs._compile_listener_installed

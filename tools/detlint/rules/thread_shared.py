@@ -1,0 +1,137 @@
+"""Classes that spawn threads must declare their shared mutable state.
+
+A class that starts a ``threading.Thread`` (constructor call, a
+``Thread`` subclass instantiating itself, or a target handed to an
+executor) has — by construction — at least two threads of control
+touching its instance. Which attributes those threads share is the
+single most load-bearing fact about the class, and the one Python
+gives you no syntax for. This rule makes it declarative:
+
+* any class whose body contains a ``threading.Thread(...)`` /
+  ``Thread(...)`` spawn (or subclasses ``threading.Thread``) must
+  define a ``_THREAD_SHARED`` class attribute: a tuple of the
+  instance-attribute names that are mutated after construction and
+  visible from more than one thread of control. An empty tuple is a
+  legitimate declaration ("the spawned thread touches only closure
+  locals / synchronized channels") — the point is that the author
+  *said so*;
+* the deeper question — is every name in that tuple actually guarded
+  or waived? — belongs to the concurrency auditor
+  (``analysis/concurrency_audit.py``), which cross-checks the declared
+  tuple against its thread-of-control discovery (``make
+  concurrency-audit``). This rule is the cheap structural gate that
+  makes the declaration exist at all;
+* a spawn site that genuinely needs no declaration (e.g. a throwaway
+  script-level helper) annotates ``# thread-shared-ok: <reason>`` on
+  the spawning line.
+
+The blessed idiom is ``parallel/supervisor.py``::
+
+    class Supervisor:
+        _THREAD_SHARED = ("_alive", "_closing", ...)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import Finding
+
+NAME = "thread-shared"
+SCOPE = ("distributed_embeddings_tpu/**",)
+
+MARKER = "thread-shared-ok:"
+
+DECL = "_THREAD_SHARED"
+
+
+def _is_thread_ctor(func: ast.expr, thread_names: set) -> bool:
+    """``Thread(...)`` via an imported name or ``<mod>.Thread(...)``."""
+    if isinstance(func, ast.Name):
+        return func.id in thread_names
+    if isinstance(func, ast.Attribute):
+        return func.attr == "Thread"
+    return False
+
+
+def _spawn_lines(cls: ast.ClassDef, thread_names: set) -> list:
+    """Line numbers of every thread spawn lexically inside the class."""
+    lines = []
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call) and _is_thread_ctor(node.func,
+                                                          thread_names):
+            lines.append(node.lineno)
+    return lines
+
+
+def _subclasses_thread(cls: ast.ClassDef) -> bool:
+    for base in cls.bases:
+        if isinstance(base, ast.Name) and base.id == "Thread":
+            return True
+        if isinstance(base, ast.Attribute) and base.attr == "Thread":
+            return True
+    return False
+
+
+def _declares(cls: ast.ClassDef) -> "ast.stmt | None":
+    """The class-body ``_THREAD_SHARED = (...)`` assignment, if any."""
+    for stmt in cls.body:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name) and t.id == DECL:
+                    return stmt
+        elif isinstance(stmt, ast.AnnAssign):
+            if isinstance(stmt.target, ast.Name) and stmt.target.id == DECL:
+                return stmt
+    return None
+
+
+def _decl_is_str_tuple(stmt: ast.stmt) -> bool:
+    value = getattr(stmt, "value", None)
+    if not isinstance(value, ast.Tuple):
+        return False
+    return all(isinstance(e, ast.Constant) and isinstance(e.value, str)
+               for e in value.elts)
+
+
+def check(tree: ast.Module, path: str, src: str, ctx) -> list:
+    lines = src.splitlines()
+    thread_names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "threading":
+            for a in node.names:
+                if a.name == "Thread":
+                    thread_names.add(a.asname or a.name)
+
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        spawns = _spawn_lines(node, thread_names)
+        if _subclasses_thread(node):
+            spawns.append(node.lineno)
+        if not spawns:
+            continue
+        unwaived = [ln for ln in spawns
+                    if ln <= len(lines) and MARKER not in lines[ln - 1]]
+        if not unwaived:
+            continue
+        decl = _declares(node)
+        if decl is None:
+            findings.append(Finding(
+                NAME, path, node.lineno,
+                f"class {node.name} spawns a thread (line"
+                f"{'s' if len(unwaived) > 1 else ''} "
+                f"{', '.join(map(str, sorted(unwaived)))}) but declares no "
+                f"{DECL} tuple of shared mutable attributes — declare one "
+                "(an empty tuple is a valid declaration) or annotate the "
+                f"spawn line '# {MARKER} <reason>'; the concurrency "
+                "auditor cross-checks the declared names"))
+        elif not _decl_is_str_tuple(decl):
+            findings.append(Finding(
+                NAME, path, decl.lineno,
+                f"class {node.name}: {DECL} must be a literal tuple of "
+                "attribute-name strings — the concurrency auditor parses "
+                "it statically"))
+    findings.sort(key=lambda x: x.line)
+    return findings
