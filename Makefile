@@ -9,7 +9,7 @@ SHELL := /bin/bash
         check-backend check-obs check-obs-report check-resilience \
         check-reshard check-recovery check-streaming check-serving \
         check-online check-obsplane check-phase-profile check-isolation \
-        obs-report phase-profile
+        check-tracing obs-report phase-profile
 
 all: native
 
@@ -35,7 +35,7 @@ verify: lint plan-audit audit-step hlo-audit schedule-audit \
         concurrency-audit check-backend \
         check-obs check-obs-report check-phase-profile check-resilience \
         check-reshard check-recovery check-streaming check-serving \
-        check-online check-obsplane check-isolation
+        check-online check-obsplane check-isolation check-tracing
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -179,6 +179,16 @@ check-online:
 # training CRC-identical to the serving-free run; tools/check_isolation.py
 check-isolation:
 	python tools/check_isolation.py
+
+# cross-process tracing drill: world-8 supervised worker under
+# die@<rid> + burst; one retained trace must CROSS the restart
+# (worker_restarted / served_after_restart marks), every retained
+# trace's stage spans must sum to latency_ms within 1e-6 ms (including
+# the five-stage partitions pickled over the supervisor boundary), the
+# federated /metrics scrape must serve the worker's families next to
+# the supervisor's, at 0 steady-state recompiles; tools/check_tracing.py
+check-tracing:
+	python tools/check_tracing.py
 
 # observability-plane drill: a world-8 child serves under burst chaos
 # while its Prometheus endpoint is scraped MID-LOAD over real HTTP; the

@@ -1687,6 +1687,114 @@ def run_obs_plane():
     }
 
 
+def run_tracing():
+    """Request-tracing cost section (ISSUE 20): what the trace plane
+    charges the serve path, measured as two back-to-back world-1
+    serving runs over the SAME request stream — tracing disabled
+    (``ServingRuntime(trace=False)``: the ratcheted baseline) and
+    tracing at retain-everything pressure (``sample=1.0``, every finish
+    retained, the worst case a production sample rate can only improve
+    on).
+
+    * ``tracing_off_rps`` / ``tracing_on_rps`` — served-request
+      throughput of each run; the off number rides the regression
+      ratchet, the on number must stay within a bounded fraction of it;
+    * ``overhead_us_per_req`` — the per-request wall delta the tracer
+      charged under full retention;
+    * ``ring_dump_bytes`` — the gzipped Chrome export of the full
+      256-trace ring (the artifact a post-mortem ships);
+    * ``span_sum_ok`` — 1 iff every retained trace's stage spans sum to
+      its ``latency_ms`` within ``SPAN_SUM_TOL_MS``;
+    * ``steady_state_recompiles`` — both runs; tracing must not perturb
+      the serve ladder's compile cache."""
+    import tempfile
+
+    from distributed_embeddings_tpu.parallel import (
+        DistributedEmbedding, ServeConfig, ServingRuntime,
+        init_hybrid_state)
+    from distributed_embeddings_tpu.parallel import serving as sv
+    from distributed_embeddings_tpu.utils import reqtrace
+
+    global _STEADY_RECOMPILES
+    sizes = [2000, 500]
+    configs = [{"input_dim": v, "output_dim": 8} for v in sizes]
+    de = DistributedEmbedding(configs, world_size=1)
+    tx = optax.sgd(0.05)
+    state = init_hybrid_state(de, SparseSGD(),
+                              {"w": jnp.ones((8 * len(sizes) + 2, 1),
+                                             jnp.float32) * 0.01},
+                              tx, jax.random.key(0))
+
+    def pred_fn(dp, outs, batch):
+        x = jnp.concatenate(list(outs) + [batch], axis=-1)
+        return jax.nn.sigmoid(x @ dp["w"])[:, 0]
+
+    requests = 64 if SMOKE else 512
+    rng_tmpl = np.random.default_rng(3)
+    tmpl = sv.synthetic_request(rng_tmpl, sizes, 2, numerical=2)
+
+    def run_one(trace_on):
+        global _STEADY_RECOMPILES
+        rt = ServingRuntime(de, pred_fn, state,
+                            config=ServeConfig(max_batch=16,
+                                               max_wait_ms=0.0,
+                                               deadline_ms=60_000.0,
+                                               max_queue=4096),
+                            trace=trace_on)
+        if trace_on:
+            # retain-everything pressure: the worst-case write path
+            # (every finish hashes, copies, and rings), deterministic
+            rt.traces = reqtrace.TraceBuffer(
+                capacity=256, sample=1.0, seed=0, enabled=True,
+                process="serve", top_fn=rt._trace_top_decile)
+        rt.warmup((tmpl.cats, tmpl.batch))
+        rng = np.random.default_rng(7)   # same stream both runs
+        served = 0
+        t0 = time.perf_counter()
+        for i in range(requests):
+            rt.submit(sv.synthetic_request(rng, sizes,
+                                           int(rng.integers(1, 5)),
+                                           numerical=2))
+            if i % 4 == 3:
+                served += sum(isinstance(r, sv.Served)
+                              for r in rt.poll())
+        served += sum(isinstance(r, sv.Served) for r in rt.flush())
+        wall = time.perf_counter() - t0
+        # read steady-state recompiles HERE, before the next run_one
+        # compiles its own fresh ladder (the compile counter is
+        # process-wide; a later read would misattribute those)
+        steady = int(rt.stats()["steady_state_recompiles"])
+        _STEADY_RECOMPILES += steady
+        return rt, served, wall, steady
+
+    rt_off, served_off, wall_off, steady_off = run_one(False)
+    rt_on, served_on, wall_on, steady_on = run_one(True)
+
+    snap = rt_on.traces.snapshot()
+    span_sum_ok = int(bool(snap) and all(
+        abs(sum(t["stages_ms"].values()) - t["latency_ms"])
+        <= reqtrace.SPAN_SUM_TOL_MS for t in snap))
+    with tempfile.TemporaryDirectory(prefix="detpu_bench_trace_") as tmp:
+        path = os.path.join(tmp, "ring.trace.json.gz")
+        rt_on.traces.export(path)
+        ring_dump_bytes = os.path.getsize(path)
+
+    return {
+        "requests": requests,
+        "tracing_off_rps": round(served_off / wall_off, 1),
+        "tracing_on_rps": round(served_on / wall_on, 1),
+        "overhead_us_per_req": round(
+            (wall_on - wall_off) / requests * 1e6, 2),
+        "retained": len(snap),
+        "ring_capacity": rt_on.traces.stats()["capacity"],
+        "span_sum_ok": span_sum_ok,
+        "ring_dump_bytes": ring_dump_bytes,
+        "trace_off_disabled": int(not rt_off.traces.stats()["enabled"]),
+        "served_off": served_off, "served_on": served_on,
+        "steady_state_recompiles": steady_off + steady_on,
+    }
+
+
 def run_isolated_serving():
     """Process-isolated serving section (ISSUE 18): what the process
     boundary costs and what the supervision buys, on the SAME model the
@@ -2239,6 +2347,12 @@ def main():
         # compare_bench's check_obs_plane ratchets the costs and fails a
         # record whose scrape broke or whose section disappeared
         out["obs_plane"] = obsplane
+    tracing = _guard("tracing", run_tracing)
+    if tracing is not None:
+        # gated by tools/compare_bench.py::check_tracing: tracing-off
+        # throughput rides the regression ratchet, tracing-on must stay
+        # within a bounded fraction of it, the span partition must hold
+        out["tracing"] = tracing
     reshard = _guard("reshard", run_reshard)
     if reshard is not None:
         out["reshard"] = reshard

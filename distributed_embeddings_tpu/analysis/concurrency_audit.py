@@ -134,11 +134,14 @@ LOCK_TYPES = {"Lock", "RLock", "Condition"}
 #: constructor names whose instances synchronize internally — mutating
 #: method calls on such attributes are not shared-state findings
 #: (QuantileSketch/MetricsRegistry/FlightRecorder are documented
-#: thread-safe in utils/mplane.py; ``local`` is threading.local)
+#: thread-safe in utils/mplane.py; TraceBuffer holds one internal lock
+#: around its active table + retained ring in utils/reqtrace.py;
+#: ``local`` is threading.local)
 SYNCHRONIZED_TYPES = LOCK_TYPES | {
     "Event", "Semaphore", "BoundedSemaphore", "Barrier",
     "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
-    "QuantileSketch", "MetricsRegistry", "FlightRecorder", "local",
+    "QuantileSketch", "MetricsRegistry", "FlightRecorder",
+    "TraceBuffer", "local",
 }
 
 #: method names that mutate their receiver in place
@@ -1230,7 +1233,35 @@ REFERENCE_CONTRACTS: Dict[str, ConcurrencyContract] = {
                 },
             },
             reason="open-loop realtime arrivals vs trainer-side RCU "
-                   "snapshot publication on one runtime instance"),
+                   "snapshot publication on one runtime instance; the "
+                   "trace ring (self.traces, a TraceBuffer) is written "
+                   "from submit/poll/flush threads and read by the "
+                   "exporter's _collect + stats() — synchronized "
+                   "internally (SYNCHRONIZED_TYPES)"),
+        ConcurrencyContract(
+            module="utils/reqtrace.py",
+            threads=(),
+            external_roots={
+                # the buffer spawns nothing but is driven from every
+                # serving-plane thread: the driver finishes traces, the
+                # supervisor's monitor thread finishes + appends
+                # restart marks, the mplane exporter thread reads
+                # stats() for the trace-ring gauge, and online.py's
+                # trainer thread drains into the flight recorder
+                "TraceBuffer": {
+                    "begin": "realtime-driver",
+                    "finish": "supervisor-monitor",
+                    "append_event": "supervisor-monitor",
+                    "annotate": "supervisor-monitor",
+                    "stats": "metrics-exporter",
+                    "drain_new": "trainer",
+                },
+            },
+            reason="one internal lock serializes the active table, the "
+                   "bounded retained ring, and the drain cursor; every "
+                   "public method is a single lock-held critical "
+                   "section, so cross-thread callers need no external "
+                   "ordering"),
         ConcurrencyContract(
             module="utils/obs.py",
             threads=(),

@@ -435,6 +435,13 @@ class OnlineRuntime:
                 # ring a serving-stats snapshot so a post-mortem shows
                 # the serve plane's recent history, not just training's
                 rec.note_stats(self.serving.stats())
+                # ... and the newly retained request traces, so a crash
+                # dump carries the exact slow/failed requests that led
+                # up to it (drain_new is an exactly-once cursor)
+                traces = getattr(self.serving, "traces", None)
+                if traces is not None:
+                    for tr in traces.drain_new():
+                        rec.note_trace(tr)
             if warmup_template is not None and not self.serving._warm:
                 # after the train step's compile, before any traffic:
                 # the steady-state recompile baseline includes every

@@ -86,7 +86,7 @@ from typing import (Any, Callable, Dict, List, Optional, Sequence, Tuple)
 import jax
 import numpy as np
 
-from ..utils import envvars, mplane, obs
+from ..utils import envvars, mplane, obs, reqtrace
 from ..utils import runtime as runtime_mod
 from ..ops.embedding_lookup import Ragged
 from . import streaming as streaming_mod
@@ -217,6 +217,12 @@ class Request:
     n: int = 0
     t_submit: float = 0.0
     deadline: float = 0.0
+    # span context (utils/reqtrace.py): minted at submit, or provided by
+    # an upstream minter (the supervisor) — it pickles across the worker
+    # socket with the rest of the request, which is HOW one trace id
+    # spans the process boundary: the worker's runtime adopts it in
+    # _normalize and its stage spans re-parent under the upstream trace
+    trace: Optional[Dict[str, Any]] = None
 
 
 @dataclasses.dataclass
@@ -260,6 +266,10 @@ class Overloaded(ServeResult):
     reason: str = "queue_full"
     level: int = 0
     queue_samples: int = 0
+    # minimal decomposition: everything a shed request spent was queue
+    # admission time (0 — refused at the door), kept span-shaped so the
+    # unhealthy tail reads like the healthy one
+    spans: Optional[Dict[str, float]] = None
 
 
 @dataclasses.dataclass
@@ -269,6 +279,9 @@ class Expired(ServeResult):
     is waiting for. Counted ``deadline_missed``."""
 
     deadline_ms: float = 0.0
+    # minimal decomposition: an expired request's whole life was queue
+    # wait — ``{"queue_wait_ms": latency_ms}`` by construction
+    spans: Optional[Dict[str, float]] = None
 
 
 @dataclasses.dataclass
@@ -281,6 +294,9 @@ class Failed(ServeResult):
     recorded as a ``serve_flush_error`` event."""
 
     reason: str = ""
+    # minimal decomposition: time from submit to the flush failure,
+    # booked as queue wait (the flush's own spans died with it)
+    spans: Optional[Dict[str, float]] = None
 
 
 @dataclasses.dataclass
@@ -300,6 +316,10 @@ class Unavailable(ServeResult):
     reason: str = "worker_down"
     outage_s: float = 0.0
     restarts: int = 0
+    # minimal decomposition: how long the request waited before the
+    # supervisor answered for the dead worker (0 when refused on
+    # arrival, the stranded wait when answered by _on_worker_down)
+    spans: Optional[Dict[str, float]] = None
 
 
 # ----------------------------------------------------------- the runtime
@@ -326,7 +346,8 @@ class ServingRuntime:
     def __init__(self, de, pred_fn: Callable, state, mesh=None,
                  config: Optional[ServeConfig] = None,
                  streaming: Optional[tuple] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 trace: Optional[bool] = None):
         self.de = de
         self.config = config or ServeConfig()
         self.world = int(de.world_size)
@@ -392,6 +413,12 @@ class ServingRuntime:
         stage_fam = self.metrics.sketch(
             "detpu_serve_stage_ms",
             "served-request latency decomposition by stage (ms)")
+        # the plain (outcome-less) children below are the SERVED-only
+        # partition stats() sums against end-to-end latency; terminal
+        # non-served outcomes observe into outcome-labeled siblings via
+        # _terminal_spans so the unhealthy tail is counted without
+        # skewing that sum
+        self._stage_fam = stage_fam
         self._stage_sketch = {s: stage_fam.child(stage=s) for s in STAGES}
         self._qdepth_sketch = self.metrics.sketch(
             "detpu_serve_queue_depth",
@@ -419,6 +446,36 @@ class ServingRuntime:
         self._freshness_max_steps = envvars.get_int(
             "DETPU_FRESHNESS_MAX_STEPS")
         self._freshness_max_s = envvars.get_float("DETPU_FRESHNESS_MAX_S")
+        # ---- request tracing (utils/reqtrace.py): a trace per rid,
+        # minted in _normalize (or adopted from Request.trace when an
+        # upstream supervisor minted it), finished with the five-stage
+        # partition in _run_flush or the minimal queue_wait span on a
+        # terminal outcome. ``trace=None`` defers to DETPU_TRACE; the
+        # bench passes explicit False/True to measure the delta
+        self.traces = reqtrace.TraceBuffer(
+            enabled=trace, process="serve", top_fn=self._trace_top_decile)
+
+    def _trace_top_decile(self) -> Optional[float]:
+        """Tail-retention threshold: the latency sketch's q90 once it
+        has enough samples to mean something (None while cold — a cold
+        threshold would retain everything and drown the sample)."""
+        sk = self._lat_sketch
+        return sk.quantile(0.9) if sk.count >= 20 else None
+
+    def _terminal_spans(self, rid: int, outcome: str, latency_ms: float,
+                        t_end: float, **attrs: Any) -> Dict[str, float]:
+        """Book one terminal non-served outcome: observe its queue wait
+        into the outcome-labeled stage sketch (the unhealthy tail stops
+        under-counting) and finish its trace with the minimal
+        ``{"queue_wait": latency_ms}`` partition — always retained, by
+        the tail-sampling policy. Returns the ``spans`` dict the typed
+        result carries (same ``<stage>_ms`` key shape as Served)."""
+        lat = max(0.0, float(latency_ms))
+        self._stage_fam.child(stage="queue_wait",
+                              outcome=outcome).observe(lat)
+        self.traces.finish(rid, outcome, latency_ms, t_end,
+                           {"queue_wait": latency_ms}, **attrs)
+        return {"queue_wait_ms": latency_ms}
 
     def _collect(self) -> None:
         """Scrape-time adapter: mirror the host counts and point-in-time
@@ -443,6 +500,9 @@ class ServingRuntime:
             self.steady_recompiles())
         g("detpu_serve_freshness_stale",
           "1 while the freshness SLO is breached").set(int(self._stale))
+        g("detpu_serve_trace_ring",
+          "tail-sampled request traces retained in the ring").set(
+            self.traces.stats()["retained"])
 
     def _count(self, key: str, n: int = 1) -> None:
         """Bump one outcome counter under the state lock. A bare dict
@@ -583,8 +643,11 @@ class ServingRuntime:
                 if rec is not None:
                     # freshness/SLO breach: park a post-mortem while the
                     # breach is live (the black box names the lagging
-                    # version and carries the recent stats ring)
+                    # version and carries the recent stats ring, plus
+                    # the exemplar requests that led up to the breach)
                     rec.note_stats(self.stats())
+                    for tr in self.traces.drain_new():
+                        rec.note_trace(tr)
                     rec.dump("freshness_breach", version=int(version),
                              lag_steps=int(lag_steps), age_s=float(age_s))
             self._stale = stale
@@ -663,6 +726,11 @@ class ServingRuntime:
               else self.config.deadline_ms)
         req.deadline_ms = float(dl)
         req.deadline = now + dl / 1e3
+        # trace mint point: every admitted-or-shed rid gets a span
+        # context here; a context already on the request (the supervisor
+        # minted upstream) is adopted, re-parenting this runtime's spans
+        req.trace = self.traces.begin(req.rid, now, ctx=req.trace,
+                                      priority=req.priority, n=req.n)
         return req
 
     def _spec_of(self, cats, batch) -> tuple:
@@ -713,8 +781,12 @@ class ServingRuntime:
                 self._count("stale_shed")
             obs.counter_inc("serve_shed")
             self._update_level()
+            spans = self._terminal_spans(req.rid, "overloaded", 0.0, now,
+                                         reason=reason, level=self._level,
+                                         queue_samples=q)
             return Overloaded(rid=req.rid, latency_ms=0.0, reason=reason,
-                              level=self._level, queue_samples=q)
+                              level=self._level, queue_samples=q,
+                              spans=spans)
         with self._state_lock:
             self._queue.append(req)
             self._queued_samples += req.n
@@ -932,6 +1004,9 @@ class ServingRuntime:
             self._total_slots += rung
             self._counts["flushes"] += 1
             self._rung_flushes[rung] = self._rung_flushes.get(rung, 0) + 1
+            # the flush ordinal doubles as the coalesce-span id linking
+            # the N request traces that shared this flush
+            flush_id = self._counts["flushes"]
         # latency decomposition: the flush-level spans are shared by
         # every coalesced request (they waited on the SAME pack /
         # dispatch / device / slice work); queue wait is per request.
@@ -975,6 +1050,15 @@ class ServingRuntime:
                 self._count("deadline_missed")
                 obs.counter_inc("serve_deadline_missed")
             obs.counter_inc("serve_served")
+            # the trace's stage partition is exactly the spans dict
+            # (bare stage names): sum == latency_ms by the telescoping
+            # construction above — the 1e-6 invariant check-tracing
+            # asserts on every retained trace
+            self.traces.finish(r.rid, "served", lat, t1,
+                               dict(zip(STAGES, spans.values())),
+                               flush=flush_id, coalesced=len(reqs),
+                               rung=rung, flush_t0=t0, version=version,
+                               deadline_missed=missed)
             out.append(Served(rid=r.rid, latency_ms=lat,
                               predictions=pred, rung=rung,
                               deadline_missed=missed, version=version,
@@ -1002,6 +1086,7 @@ class ServingRuntime:
             # spend rung slots on them (strictly past: at exactly the
             # deadline the flush below still gets its chance)
             keep = []
+            expired_now: List[Request] = []
             with self._state_lock:
                 for r in self._queue:
                     if r.deadline < t:
@@ -1009,13 +1094,19 @@ class ServingRuntime:
                         self._counts["expired"] += 1
                         self._counts["deadline_missed"] += 1
                         obs.counter_inc("serve_deadline_missed")
-                        out.append(Expired(
-                            rid=r.rid,
-                            latency_ms=(t - r.t_submit) * 1e3,
-                            deadline_ms=r.deadline_ms))
+                        expired_now.append(r)
                     else:
                         keep.append(r)
                 self._queue = keep
+            # span booking outside the state lock (sketch + trace locks
+            # are leaves; no reason to nest them under the queue's)
+            for r in expired_now:
+                lat = (t - r.t_submit) * 1e3
+                spans = self._terminal_spans(r.rid, "expired", lat, t,
+                                             deadline_ms=r.deadline_ms)
+                out.append(Expired(rid=r.rid, latency_ms=lat,
+                                   deadline_ms=r.deadline_ms,
+                                   spans=spans))
             if not self._queue:
                 break
             oldest = self._queue[0]
@@ -1064,7 +1155,11 @@ class ServingRuntime:
             t = self._clock()
             return [Failed(rid=r.rid,
                            latency_ms=(t - r.t_submit) * 1e3,
-                           reason=repr(e)) for r in picked]
+                           reason=repr(e),
+                           spans=self._terminal_spans(
+                               r.rid, "failed",
+                               (t - r.t_submit) * 1e3, t,
+                               reason=repr(e))) for r in picked]
 
     def flush(self, now: Optional[float] = None) -> List[ServeResult]:
         """Force every queued request out (drain), regardless of the
@@ -1102,6 +1197,16 @@ class ServingRuntime:
             }
         dominant = (max(stages, key=lambda s: stages[s]["p99"])
                     if stages else None)
+        # the unhealthy tail, by outcome: the outcome-labeled siblings
+        # _terminal_spans observes (kept OUT of latency_stages_ms so the
+        # served partition still sums against served latency)
+        unhealthy: Dict[str, Dict[str, float]] = {}
+        for key, sk in self._stage_fam.items():
+            oc = dict(key).get("outcome")
+            if oc and sk.count:
+                unhealthy[oc] = {"p95": sk.quantile(0.95),
+                                 "p99": sk.quantile(0.99),
+                                 "sum": sk.sum, "count": sk.count}
         meta = self._published[2]
         return {
             **self._counts,
@@ -1113,6 +1218,12 @@ class ServingRuntime:
             "latency_p99_ms": pct(99),
             "latency_stages_ms": stages,
             "p99_dominant_stage": dominant,
+            "latency_stages_unhealthy_ms": unhealthy,
+            # exemplar join: the slowest retained traces with their
+            # per-stage breakdowns — the p99 is no longer just a number,
+            # it names requests
+            "p99_exemplars": self.traces.exemplars(5),
+            "trace": self.traces.stats(),
             "pad_fraction": (self._pad_slots / self._total_slots
                              if self._total_slots else 0.0),
             "queue_depth_p95": (self._qdepth_sketch.quantile(0.95)

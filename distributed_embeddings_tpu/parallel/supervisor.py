@@ -60,7 +60,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..utils import envvars, mplane, obs
+from ..utils import envvars, mplane, obs, reqtrace
 from ..utils import runtime as runtime_mod
 from ..utils import shm as shm_mod
 from .serving import ServeResult, Served, Unavailable
@@ -78,6 +78,13 @@ START_TIMEOUT_ENV = "DETPU_SUPERVISE_START_TIMEOUT_S"
 # init deadlocks, and a supervisor lives in a process that has
 # necessarily initialised jax (it trains)  # spawn-ok: module policy
 _SPAWN = multiprocessing.get_context("spawn")
+
+#: metrics-federation cadence: the worker attaches its registry's
+#: ``to_dict`` document to at most one pong per this many seconds (the
+#: document is a few KB of counters + sketch buckets — cheap, but not
+#: per-heartbeat cheap), so the supervisor's merged ``/metrics`` view
+#: lags the worker by at most this plus one heartbeat
+_FED_EVERY_S = 0.5
 
 
 # ------------------------------------------------- snapshot serialization
@@ -259,6 +266,7 @@ def _worker_body(conn, spec: Dict[str, Any]) -> None:
     die_at = set(runtime_mod.die_steps())
     hang_at = set(runtime_mod.hang_steps())
     ridmap: Dict[int, int] = {}  # runtime rid -> supervisor rid
+    last_fed = 0.0  # last metrics-federation send (worker monotonic)
     conn.send(("ready", {"pid": os.getpid(),
                          "warmup_compiles": rt.warmup_compiles,
                          "metrics_port": exporter.port if exporter else None}))
@@ -292,7 +300,16 @@ def _worker_body(conn, spec: Dict[str, Any]) -> None:
             msg = conn.recv()
             kind = msg[0]
             if kind == "ping":
-                conn.send(("pong", msg[1]))
+                # metrics federation rides the heartbeat it already
+                # pays for: at most one registry snapshot per
+                # _FED_EVERY_S, so the supervisor's /metrics can serve
+                # the worker's families without a second channel
+                fed = None
+                wnow = time.monotonic()
+                if wnow - last_fed >= _FED_EVERY_S:
+                    last_fed = wnow
+                    fed = rt.metrics.to_dict()
+                conn.send(("pong", msg[1], fed))
             elif kind == "request":
                 sup_rid, ordinal, req = msg[1], msg[2], msg[3]
                 if ordinal in die_at:
@@ -400,9 +417,10 @@ class Supervisor:
     # swap by a sole writer)
     _THREAD_SHARED = (
         "_alive", "_closing", "_counts", "_down_reason", "_down_since",
-        "_inflight", "_last_pong", "_last_train_step", "_last_version",
-        "_next_rid", "_restarts", "_results", "_shm", "_slo", "_warm",
-        "_worker", "_worker_stats", "restart_budget_exhausted",
+        "_fed_archive", "_fed_latest", "_inflight", "_last_pong",
+        "_last_train_step", "_last_version", "_next_rid", "_outage_trace",
+        "_restarts", "_results", "_shm", "_slo", "_warm", "_worker",
+        "_worker_stats", "restart_budget_exhausted",
     )
 
     def __init__(self, factory: str, kwargs: Optional[Dict[str, Any]] = None,
@@ -442,6 +460,78 @@ class Supervisor:
         self._send_q: "queue.Queue" = queue.Queue()
         self._monitor: Optional[threading.Thread] = None
         self._sender: Optional[threading.Thread] = None
+        # ---- request tracing (utils/reqtrace.py): the supervisor MINTS
+        # each trace at submit; the context rides the request over the
+        # socket and the worker's runtime adopts it, so its stage spans
+        # re-parent under this id — across die@ restarts too. The trace
+        # the outage touched LAST (newest stranded rid, then each
+        # refused-during-outage rid in turn — the one the bounded ring
+        # cannot have evicted) is remembered in _outage_trace; when the
+        # reborn worker serves its first request, worker_restarted /
+        # served_after_restart marks are appended to it: ONE retained
+        # trace shows submit -> outage -> Unavailable -> restart ->
+        # served (what make check-tracing asserts)
+        self._e2e_ms = mplane.QuantileSketch()  # end-to-end, this side
+        self.traces = reqtrace.TraceBuffer(process="supervisor",
+                                           top_fn=self._trace_top_decile)
+        self._outage_trace: Optional[str] = None
+        # ---- metrics federation: the worker's registry documents
+        # arrive on pongs (_fed_latest); a dead incarnation's last
+        # document is absorbed into _fed_archive (sketch-merged), so
+        # counts survive restarts. The supervisor's own registry serves
+        # ONE merged /metrics view over both plus its own families
+        self._fed_latest: Optional[Dict[str, Any]] = None
+        self._fed_archive: Optional[Dict[str, Any]] = None
+        self.metrics = mplane.MetricsRegistry()
+        self.metrics.register_collector(self._collect_metrics)
+        self.metrics.add_federated(self._federated_doc)
+
+    def _trace_top_decile(self) -> Optional[float]:
+        """Tail-retention threshold: q90 of the end-to-end latency the
+        supervisor itself observed (None while cold)."""
+        return (self._e2e_ms.quantile(0.9) if self._e2e_ms.count >= 20
+                else None)
+
+    def _collect_metrics(self) -> None:
+        """Scrape-time adapter for the supervisor's OWN families (the
+        worker's arrive via federation)."""
+        with self._lock:
+            alive = self._alive
+            restarts = self._restarts
+            outage = 0.0 if alive else self._clock() - self._down_since
+            exhausted = self.restart_budget_exhausted
+            counts = dict(self._counts)
+        mplane.sync_counters(self.metrics, counts,
+                             name="detpu_supervisor_total", label="outcome")
+        g = self.metrics.gauge
+        g("detpu_supervisor_worker_alive",
+          "1 while the serving worker is up").set(int(alive))
+        g("detpu_supervisor_restarts",
+          "supervised worker restarts spent").set(restarts)
+        g("detpu_supervisor_outage_s",
+          "current outage age (0 while the worker is up)").set(outage)
+        g("detpu_supervisor_restart_budget_exhausted",
+          "1 once the restart budget is spent").set(int(exhausted))
+        g("detpu_supervisor_trace_ring",
+          "retained supervisor-side request traces").set(
+            self.traces.stats()["retained"])
+        if self._publish_ms.count:
+            g("detpu_supervisor_shm_publish_p95_ms",
+              "seqlock snapshot publish latency p95 (ms)").set(
+                self._publish_ms.quantile(0.95))
+
+    def _federated_doc(self) -> Optional[Dict[str, Any]]:
+        """The worker-side registry document for the merged scrape: the
+        live incarnation's latest, sketch-merged over every dead
+        incarnation's final document."""
+        with self._lock:
+            docs = [d for d in (self._fed_archive, self._fed_latest) if d]
+        if not docs:
+            return None
+        # merge outside the lock: the documents are immutable once
+        # stored (swaps replace the reference, merge copies)
+        return (mplane.merge_registry_docs(docs) if len(docs) > 1
+                else docs[0])
 
     # ------------------------------------------------------------ spawn
 
@@ -554,8 +644,10 @@ class Supervisor:
         kind = msg[0]
         if kind == "result":
             res = msg[1]
+            first_after_restart = False
             with self._lock:
-                if self._inflight.pop(res.rid, None) is None:
+                t_sub = self._inflight.pop(res.rid, None)
+                if t_sub is None:
                     # already answered Unavailable at crash detection —
                     # a late duplicate would break request conservation
                     return
@@ -565,6 +657,42 @@ class Supervisor:
                     self._restart_to_serve_ms.append(
                         (now - self._awaiting_first_served) * 1e3)
                     self._awaiting_first_served = None
+                    first_after_restart = True
+                outage_trace = self._outage_trace
+                restarts = self._restarts
+            # supervisor-side trace: the worker's stage spans verbatim
+            # (their sum equals res.latency_ms exactly — the partition
+            # crossed the boundary intact); the socket/queue overhead
+            # this side observed on top is a boundary mark, outside the
+            # partition by design
+            spans = getattr(res, "spans", None)
+            stages = ({k[:-3]: v for k, v in spans.items()} if spans
+                      else {"queue_wait": res.latency_ms})
+            boundary_ms = max(0.0, (now - t_sub) * 1e3 - res.latency_ms)
+            self._e2e_ms.observe((now - t_sub) * 1e3)
+            self.traces.event(res.rid, "boundary", t=now,
+                              dur_ms=boundary_ms)
+            self.traces.finish(res.rid, res.status, res.latency_ms, now,
+                               stages, boundary_ms=boundary_ms,
+                               restarts=restarts)
+            if first_after_restart and outage_trace is not None:
+                # the restart-crossing evidence: the outage's first
+                # stranded trace now carries the full arc
+                self.traces.append_event(outage_trace, "worker_restarted",
+                                         t=now, restarts=restarts)
+                self.traces.append_event(outage_trace,
+                                         "served_after_restart", t=now,
+                                         dur_ms=res.latency_ms,
+                                         served_rid=res.rid)
+                self.traces.annotate(outage_trace, restart_crossed=True,
+                                     restarts_at_serve=restarts)
+                with self._lock:
+                    self._outage_trace = None
+        elif kind == "pong":
+            # liveness (handled above) + the piggybacked federation doc
+            if len(msg) > 2 and msg[2]:
+                with self._lock:
+                    self._fed_latest = msg[2]
         elif kind == "stats_reply":
             with self._lock:
                 self._worker_stats = msg[1]
@@ -574,7 +702,7 @@ class Supervisor:
             if self._recorder:
                 self._recorder.note_event("serve_worker_error",
                                           traceback=msg[1])
-        # "pong"/"bye" carry nothing beyond liveness
+        # "bye" carries nothing beyond liveness
 
     def _monitor_loop(self) -> None:
         last_ping = 0.0
@@ -603,19 +731,49 @@ class Supervisor:
 
     def _on_worker_down(self, reason: str) -> None:
         now = self._clock()
+        down_reason = f"worker_{reason}"
         with self._lock:
             worker, self._worker = self._worker, None
             self._alive = False
             self._down_since = now
-            self._down_reason = f"worker_{reason}"
+            self._down_reason = down_reason
             self._counts[reason] += 1
-            stranded = list(self._inflight)
+            stranded = list(self._inflight.items())
             self._inflight.clear()
-            for rid in stranded:
+            restarts = self._restarts
+            for rid, t_sub in stranded:
                 self._counts["unavailable"] += 1
                 self._results.append(Unavailable(
-                    rid=rid, latency_ms=0.0, reason=self._down_reason,
-                    outage_s=0.0, restarts=self._restarts))
+                    rid=rid, latency_ms=0.0, reason=down_reason,
+                    outage_s=0.0, restarts=restarts,
+                    spans={"queue_wait_ms":
+                           max(0.0, (now - t_sub) * 1e3)}))
+            # absorb the dead incarnation's final federation document:
+            # its counters and sketch buckets keep merging under the
+            # reborn worker's, so the scrape never forgets an outage
+            if self._fed_latest:
+                self._fed_archive = mplane.merge_registry_docs(
+                    [d for d in (self._fed_archive, self._fed_latest)
+                     if d])
+                self._fed_latest = None
+        # stranded traces finish Unavailable with the wait they actually
+        # spent (an outage mark annotates the death); the newest one
+        # becomes the outage trace the restart-crossing marks land on —
+        # later refusals during the outage keep moving the pointer
+        # forward so the bounded ring can never evict it first
+        last_tid = None
+        for rid, t_sub in stranded:
+            wait_ms = max(0.0, (now - t_sub) * 1e3)
+            self.traces.event(rid, "outage", t=now, reason=down_reason)
+            tr = self.traces.finish(rid, "unavailable", wait_ms, now,
+                                    {"queue_wait": wait_ms},
+                                    reason=down_reason, stranded=True,
+                                    restarts=restarts)
+            if tr is not None:
+                last_tid = tr["trace_id"]
+        if last_tid is not None:
+            with self._lock:
+                self._outage_trace = last_tid
         # purge queued sends: the reborn worker must not receive
         # requests whose rids were just answered Unavailable
         try:
@@ -640,6 +798,8 @@ class Supervisor:
                                       restarts=self._restarts)
             if self._worker_stats:
                 self._recorder.note_stats(self._worker_stats)
+            for tr in self.traces.drain_new():
+                self._recorder.note_trace(tr)
             self._recorder.dump("serve_worker_crash", reason=reason,
                                 pid=pid)
         self._restart()
@@ -747,20 +907,42 @@ class Supervisor:
             return len(self._inflight)
 
     def submit(self, req) -> Optional[ServeResult]:
+        now = self._clock()
         with self._lock:
             rid = self._next_rid
             self._next_rid += 1
             alive = self._alive
+            restarts = self._restarts
             if alive:
-                self._inflight[rid] = self._clock()
+                self._inflight[rid] = now
+        # mint (or adopt) the trace here, at the FRONT DOOR: the worker
+        # re-parents under this context, so one trace id survives the
+        # pickle boundary and any worker rebirth in between
+        ctx = self.traces.begin(rid, now,
+                                ctx=getattr(req, "trace", None),
+                                priority=getattr(req, "priority", 0),
+                                incarnation=restarts)
         if not alive:
             with self._lock:
                 self._counts["unavailable"] += 1
-                outage = self._clock() - self._down_since
+                outage = now - self._down_since
                 reason = self._down_reason
+            tr = self.traces.finish(rid, "unavailable", 0.0, now,
+                                    {"queue_wait": 0.0}, reason=reason,
+                                    outage_s=outage, restarts=restarts)
+            if tr is not None:
+                # keep pointing at the NEWEST outage trace: every
+                # refusal is retained ("outcome"), so under a long
+                # outage the oldest ones are exactly what the bounded
+                # ring evicts first — the newest is the one guaranteed
+                # to still be retained when the restart marks land
+                with self._lock:
+                    self._outage_trace = tr["trace_id"]
             return Unavailable(rid=rid, latency_ms=0.0, reason=reason,
-                               outage_s=outage, restarts=self._restarts)
+                               outage_s=outage, restarts=restarts,
+                               spans={"queue_wait_ms": 0.0})
         req.rid = rid
+        req.trace = ctx
         # the rid doubles as the GLOBAL stream ordinal die@/hang@ key on
         self._send_q.put(("request", rid, rid, req))
         return None
@@ -807,7 +989,13 @@ class Supervisor:
                 "restart_to_first_served_ms": (
                     self._restart_to_serve_ms[-1]
                     if self._restart_to_serve_ms else None),
+                "e2e_p99_ms": (self._e2e_ms.quantile(0.99)
+                               if self._e2e_ms.count else None),
             }
+        # the supervisor's OWN trace ring (end-to-end spans, boundary
+        # marks) — distinct from the worker's in-process ring above
+        out["supervisor"]["trace"] = self.traces.stats()
+        out["supervisor"]["p99_exemplars"] = self.traces.exemplars(5)
         return out
 
     # ---------------------------------------------------------- teardown

@@ -321,6 +321,34 @@ declare("DETPU_SUPERVISE_START_TIMEOUT_S", default="300",
             "warmup and report ready; a worker that blows it is treated "
             "as crashed (kill + backoff + next attempt)")
 
+# cross-process request tracing: per-request causal spans with
+# tail-based sampling and a bounded retained ring (utils/reqtrace.py +
+# tools/check_tracing.py = make check-tracing)
+declare("DETPU_TRACE", default="1",
+        doc="request tracing master switch: when enabled every "
+            "ServingRuntime/Supervisor submit mints a trace whose stage "
+            "spans partition the request's life (sum == latency_ms); "
+            "the per-request cost is a dict and a hash, and the bench "
+            "tracing section gates that tracing-off throughput is "
+            "unchanged. Empty/0 disables minting entirely")
+declare("DETPU_TRACE_RING", default="256",
+        doc="capacity of the retained-trace ring per TraceBuffer: "
+            "tail-sampled traces beyond this evict oldest-first, so "
+            "trace memory is bounded no matter the burst (the 10x-burst "
+            "property tests/test_reqtrace.py pins)")
+declare("DETPU_TRACE_SAMPLE", default="0.02",
+        doc="retention probability for HEALTHY served traces that miss "
+            "the latency top decile; applied as a deterministic hash of "
+            "(DETPU_TRACE_SEED, trace_id), never a random draw. "
+            "Unhealthy outcomes (expired/failed/overloaded/unavailable) "
+            "and top-decile latencies are always retained — that is the "
+            "tail-based half of the policy")
+declare("DETPU_TRACE_SEED", default="0",
+        doc="seed of the deterministic sampling hash (and of minted "
+            "trace ids): pin it and the same request stream replays the "
+            "same retention decisions run-to-run, which is what makes "
+            "sampled traces reproducible in drills and tests")
+
 # concurrency auditor: lock-discipline analysis + interleaving model
 # checker over the serving plane (analysis/concurrency_audit.py +
 # tools/concurrency_audit.py = make concurrency-audit)

@@ -366,11 +366,23 @@ class SketchFamily(_Family):
 class MetricsRegistry:
     """One namespace of labeled metric families + the render/export
     surface. Thread-safe for the scrape path (the HTTP exporter renders
-    from its own thread while the runtime observes)."""
+    from its own thread while the runtime observes).
+
+    A registry can also FEDERATE foreign registries: a source registered
+    with :meth:`add_federated` returns another process's
+    :meth:`to_dict` document (or ``None`` while there is nothing to
+    report), and every render/snapshot folds those documents in through
+    :func:`merge_registry_docs` — sketch series merge by bucket
+    addition, counters sum, gauges last-write-win. This is how the
+    supervisor's single ``/metrics`` endpoint serves the out-of-process
+    serving worker's families (including across worker incarnations:
+    the dead worker's final document keeps merging under the reborn
+    worker's live one)."""
 
     def __init__(self):
         self._families: Dict[str, _Family] = {}
         self._collectors: List[Callable[[], None]] = []
+        self._federated: List[Callable[[], Optional[Dict[str, Any]]]] = []
         self._lock = threading.Lock()
 
     def _family(self, name: str, factory: Callable[[], _Family]) -> _Family:
@@ -409,8 +421,35 @@ class MetricsRegistry:
         with self._lock:
             self._collectors.append(fn)
 
+    def add_federated(self,
+                      source: Callable[[], Optional[Dict[str, Any]]]
+                      ) -> None:
+        """Register a federation source: a callable returning a foreign
+        registry's :meth:`to_dict` document (or ``None`` when nothing is
+        available yet). Its families join every render/snapshot of THIS
+        registry via :func:`merge_registry_docs`."""
+        with self._lock:
+            self._federated.append(source)
+
+    def _federated_docs(self) -> List[Dict[str, Any]]:
+        docs: List[Dict[str, Any]] = []
+        for fn in list(self._federated):
+            try:
+                doc = fn()
+            except Exception:  # noqa: BLE001 - a broken federation
+                # source must not take the scrape surface down
+                logger.exception("mplane: federation source failed; "
+                                 "skipping")
+                continue
+            if doc:
+                docs.append(doc)
+        return docs
+
     def render(self) -> str:
-        """The Prometheus text exposition of every family."""
+        """The Prometheus text exposition of every family (own families
+        first, then federated documents — a federated family whose name
+        collides with an own one emits series lines only, so HELP/TYPE
+        stay unique)."""
         for fn in list(self._collectors):
             try:
                 fn()
@@ -442,6 +481,11 @@ class MetricsRegistry:
                 for key, child in fam.items():
                     lines.append(
                         f"{name}{_render_labels(key)} {_fmt(child.value)}")
+        fed = self._federated_docs()
+        if fed:
+            own = {name for name, _ in fams}
+            lines.append(render_doc(merge_registry_docs(fed),
+                                    skip_meta_for=own).rstrip("\n"))
         return "\n".join(lines) + "\n"
 
     def export_file(self, path: str) -> str:
@@ -470,7 +514,90 @@ class MetricsRegistry:
                 entries.append({"labels": dict(key), "value": val})
             out[name] = {"kind": fam.kind, "help": fam.help,
                          "series": entries}
+        fed = self._federated_docs()
+        if fed:
+            out = merge_registry_docs([out] + fed)
         return out
+
+
+# ------------------------------------------------ cross-process federation
+
+
+def merge_registry_docs(docs: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge :meth:`MetricsRegistry.to_dict` documents into one: series
+    are keyed by (family, label set); summary values merge as sketches
+    (bucket addition — the PR 17 mergeability, now exercised
+    cross-process), counters SUM (each document is an independent
+    process's monotone total), gauges take the last document's value
+    (documents are ordered oldest-first by convention, so 'last' is the
+    live process). Input documents are never mutated."""
+    out: Dict[str, Any] = {}
+    for doc in docs:
+        for name, fam in doc.items():
+            series = fam.get("series", [])
+            cur = out.get(name)
+            if cur is None:
+                out[name] = {"kind": fam.get("kind", "untyped"),
+                             "help": fam.get("help", ""),
+                             "series": [{"labels": dict(s["labels"]),
+                                         "value": s["value"]}
+                                        for s in series]}
+                continue
+            index = {_label_key(s["labels"]): s for s in cur["series"]}
+            kind = cur["kind"]
+            for s in series:
+                key = _label_key(s["labels"])
+                have = index.get(key)
+                if have is None:
+                    have = {"labels": dict(s["labels"]), "value": s["value"]}
+                    cur["series"].append(have)
+                    index[key] = have
+                elif kind == "summary":
+                    merged = QuantileSketch.from_dict(have["value"])
+                    merged.merge(QuantileSketch.from_dict(s["value"]))
+                    have["value"] = merged.to_dict()
+                elif kind == "counter":
+                    have["value"] = float(have["value"]) + float(s["value"])
+                else:
+                    have["value"] = s["value"]
+    return out
+
+
+def render_doc(doc: Dict[str, Any],
+               skip_meta_for: Optional[set] = None) -> str:
+    """Prometheus text exposition of a :meth:`MetricsRegistry.to_dict`
+    document (the render half of federation: merge documents first,
+    then render once). Families named in ``skip_meta_for`` emit series
+    lines only — the caller already emitted their HELP/TYPE."""
+    skip_meta = skip_meta_for or set()
+    lines: List[str] = []
+    for name in sorted(doc):
+        fam = doc[name]
+        kind = fam.get("kind", "untyped")
+        if name not in skip_meta:
+            if fam.get("help"):
+                lines.append(f"# HELP {name} {fam['help']}")
+            lines.append(f"# TYPE {name} {kind}")
+        for s in fam.get("series", []):
+            key = _label_key(s["labels"])
+            if kind == "summary":
+                sk = QuantileSketch.from_dict(s["value"])
+                for q in SketchFamily.QUANTILES:
+                    v = sk.quantile(q)
+                    if v is None:
+                        continue
+                    lines.append(
+                        f"{name}"
+                        f"{_render_labels(key, (('quantile', str(q)),))}"
+                        f" {_fmt(v)}")
+                lines.append(
+                    f"{name}_sum{_render_labels(key)} {_fmt(sk.sum)}")
+                lines.append(
+                    f"{name}_count{_render_labels(key)} {sk.count}")
+            else:
+                lines.append(
+                    f"{name}{_render_labels(key)} {_fmt(s['value'])}")
+    return "\n".join(lines) + "\n"
 
 
 _default_registry: Optional[MetricsRegistry] = None
@@ -626,6 +753,7 @@ class FlightRecorder:
         self._steps: List[Dict[str, Any]] = []
         self._events: List[Dict[str, Any]] = []
         self._stats: List[Dict[str, Any]] = []
+        self._traces: List[Dict[str, Any]] = []
         self._lock = threading.Lock()
         self.dumps = 0
 
@@ -652,11 +780,20 @@ class FlightRecorder:
         self._push(self._stats, {"source": source, "time": time.time(),
                                  "stats": _jsonable(stats)})
 
+    def note_trace(self, trace: Dict[str, Any]) -> None:
+        """Ring in one retained request trace (a
+        :meth:`~.reqtrace.TraceBuffer.drain_new` record): a
+        ``serve_worker_crash`` / ``nan_escalation`` black box ships the
+        tail-sampled exemplar requests that preceded it, CRC-covered
+        like every other ring."""
+        self._push(self._traces, _jsonable(trace))
+
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             return {"steps": list(self._steps),
                     "events": list(self._events),
-                    "stats": list(self._stats)}
+                    "stats": list(self._stats),
+                    "traces": list(self._traces)}
 
     def dump(self, trigger: str, **context: Any) -> Optional[str]:
         """Write the black box. Returns the path, or ``None`` when the

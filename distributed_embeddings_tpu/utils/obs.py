@@ -163,6 +163,21 @@ def phase_leaf(path: str) -> str:
     return path.rsplit("/", 1)[-1] if path else ""
 
 
+#: Event-name namespace for per-REQUEST trace events (utils/reqtrace.py
+#: emits them, utils/traceparse.py reads them back). Lives here, next to
+#: :data:`SCOPE_RE`, because obs.py owns the naming conventions that keep
+#: a mixed capture directory separable: ``detpu/...`` scopes mark device
+#: op events, ``req/...`` names mark request spans — phase tooling skips
+#: the latter, request-trace tooling keys on them.
+REQ_EVENT_PREFIX = "req/"
+
+
+def is_request_event(name: Optional[str]) -> bool:
+    """Whether a trace-event name belongs to the request-tracing
+    namespace (vs a device/profiler op event)."""
+    return bool(name) and str(name).startswith(REQ_EVENT_PREFIX)
+
+
 def scope(name: str):
     """``jax.named_scope("detpu/<name>")`` — phase attribution for XLA
     profiles. Trace-time-only metadata (zero run-time cost), so call sites

@@ -1,0 +1,396 @@
+"""Cross-process request tracing: per-request causal spans with
+tail-based sampling, a bounded retained ring, and Chrome-trace export.
+
+PR 17's five-stage p99 decomposition is an *aggregate*: it can say the
+``device_compute`` stage dominates the tail but not WHICH requests were
+exchange-bound, and PR 18's process boundary made even the aggregate
+one-sided (the worker's sketches never reach the supervisor's scrape).
+This module is the per-request instrument. One class, stdlib only —
+like the rest of the host layer it never imports jax or numpy, so the
+supervisor, the worker, and an offline reader all share it:
+
+* **Trace minting** — :meth:`TraceBuffer.begin` mints a deterministic
+  trace id at ``submit`` time (``f(seed, rid)`` — never a wall clock,
+  never ``random``), or ADOPTS a context minted elsewhere: the
+  supervisor mints at its ``submit``, the context dict rides the
+  existing request queue (``Request.trace``), and the worker's runtime
+  re-parents its stage spans under the supervisor's id — across a
+  ``die@`` restart the reborn worker keeps adopting, so one trace id
+  names the request's whole life on both sides of the boundary.
+* **Span model** — every finished trace carries ``stages_ms``, a dict
+  of stage spans that PARTITIONS ``[t_submit, t_end]``: their sum
+  equals ``latency_ms`` within float error (the invariant ``make
+  check-tracing`` asserts at 1e-6 ms). Served requests carry the five
+  :data:`~..parallel.serving.STAGES`; terminal non-served outcomes
+  (``expired`` / ``failed`` / ``overloaded`` / ``unavailable``) carry
+  the minimal ``{"queue_wait": latency_ms}`` span so the unhealthy
+  tail is traceable too. Lifecycle annotations (``outage``, ``worker
+  _restarted``, ``boundary``) ride ``events`` — markers, deliberately
+  OUTSIDE the partition sum.
+* **Tail-based sampling** — :meth:`finish` always retains traces whose
+  outcome is not ``served``, retains served traces whose latency lands
+  at or above the owner's top-decile threshold (``top_fn``, typically
+  the latency sketch's q90), and samples the healthy rest at
+  ``DETPU_TRACE_SAMPLE`` via a deterministic hash of ``(seed,
+  trace_id)`` — the same seed replays the same retention decisions,
+  which is what makes the sampling testable run-to-run.
+* **The bounded ring** — at most ``DETPU_TRACE_RING`` retained traces,
+  oldest evicted first; memory never grows with load (the 10x-burst
+  property ``tests/test_reqtrace.py`` pins). :meth:`drain_new` hands
+  newly retained traces to the flight recorder exactly once.
+* **Chrome-trace export** — :meth:`export` writes the ring as a
+  standard ``traceEvents`` JSON document (names under
+  :data:`~.obs.REQ_EVENT_PREFIX`, one enclosing ``req/<outcome>``
+  event per trace, ``req/stage/<name>`` children laid out
+  sequentially, one ``req/flush`` coalesce span linking the requests
+  that shared a flush) that :func:`~.traceparse.parse_request_traces`
+  and ``tools/obs_report.py --traces`` read back.
+
+The buffer is thread-safe: one internal lock covers the active table,
+the ring, and every counter — the serving driver finishes traces while
+the trainer thread annotates and the exporter thread snapshots
+(``analysis/concurrency_audit.py`` lists :class:`TraceBuffer` among
+the synchronized types for exactly this reason).
+"""
+
+from __future__ import annotations
+
+import collections
+import gzip
+import json
+import threading
+import zlib
+from typing import Any, Callable, Dict, List, Optional
+
+from . import envvars
+from .obs import REQ_EVENT_PREFIX
+
+TRACE_ENV = "DETPU_TRACE"
+RING_ENV = "DETPU_TRACE_RING"
+SAMPLE_ENV = "DETPU_TRACE_SAMPLE"
+SEED_ENV = "DETPU_TRACE_SEED"
+
+#: span-sum tolerance (ms): ``sum(stages_ms) == latency_ms`` within this
+#: for every retained trace — the partition invariant the check drills
+SPAN_SUM_TOL_MS = 1e-6
+
+
+def hash01(seed: int, trace_id: str) -> float:
+    """Deterministic [0, 1) probe for one trace id: a CRC32 of
+    ``"{seed}:{trace_id}"`` scaled down. No wall clock, no ``random``
+    module — the retention decision replays bit-identically under a
+    pinned seed (the sampling-determinism contract)."""
+    h = zlib.crc32(f"{seed}:{trace_id}".encode("utf-8")) & 0xFFFFFFFF
+    return h / 2.0 ** 32
+
+
+class TraceBuffer:
+    """Thread-safe per-process request-trace store: active table +
+    bounded retained ring + the sampling policy.
+
+    ``top_fn`` (optional) returns the owner's current top-decile
+    latency threshold in ms (e.g. the serving latency sketch's q90) or
+    ``None`` while the estimate is cold; ``process`` labels exported
+    events so merged multi-process captures stay attributable.
+    Construction resolves ``None`` policy knobs from the registered
+    ``DETPU_TRACE_*`` environment defaults.
+    """
+
+    def __init__(self, capacity: Optional[int] = None,
+                 sample: Optional[float] = None,
+                 seed: Optional[int] = None,
+                 enabled: Optional[bool] = None,
+                 process: str = "serve",
+                 top_fn: Optional[Callable[[], Optional[float]]] = None):
+        self.enabled = (envvars.enabled(TRACE_ENV) if enabled is None
+                        else bool(enabled))
+        self.capacity = max(1, int(envvars.get_int(RING_ENV)
+                                   if capacity is None else capacity))
+        self.sample = float(envvars.get_float(SAMPLE_ENV)
+                            if sample is None else sample)
+        self.seed = int(envvars.get_int(SEED_ENV) if seed is None else seed)
+        self.process = str(process)
+        self._top_fn = top_fn
+        self._lock = threading.Lock()
+        # rid -> {"trace_id", "t_submit", "events", "attrs"}; bounded by
+        # the owner's admission control (every submitted rid terminates
+        # through exactly one finish())
+        self._active: Dict[int, Dict[str, Any]] = {}
+        self._ring: collections.deque = collections.deque()
+        self._by_id: Dict[str, Dict[str, Any]] = {}  # retained index
+        self._seq = 0
+        self._drained_seq = 0
+        self.finished = 0
+        self.retained_total = 0
+        self.sampled_out = 0
+        self.evicted = 0
+
+    # ------------------------------------------------------------- intake
+
+    def mint(self, rid: int) -> str:
+        """The deterministic trace id for one rid under this buffer's
+        seed (pure function — reborn processes re-derive it)."""
+        return f"t{self.seed & 0xFFFFFFFF:08x}-{int(rid):08d}"
+
+    def begin(self, rid: int, t_submit: float,
+              ctx: Optional[Dict[str, Any]] = None,
+              **attrs: Any) -> Optional[Dict[str, Any]]:
+        """Open the trace for one rid at submit time and return its
+        portable span context (``None`` when tracing is off — callers
+        pass the result straight into ``Request.trace``).
+
+        ``ctx`` re-parents: when a context minted by another process
+        (the supervisor) rides in, its ``trace_id`` is adopted verbatim
+        so this process's spans join the existing trace instead of
+        starting a sibling."""
+        if not self.enabled:
+            return None
+        trace_id = (str(ctx["trace_id"]) if ctx and ctx.get("trace_id")
+                    else self.mint(rid))
+        rec = {"trace_id": trace_id, "t_submit": float(t_submit),
+               "events": [], "attrs": dict(attrs)}
+        if ctx and ctx.get("attrs"):
+            rec["attrs"].update(ctx["attrs"])
+        with self._lock:
+            self._active[int(rid)] = rec
+        return {"trace_id": trace_id, "rid": int(rid),
+                "t_submit": float(t_submit)}
+
+    def event(self, rid: int, name: str, t: Optional[float] = None,
+              dur_ms: float = 0.0, **attrs: Any) -> None:
+        """Append one lifecycle annotation to an ACTIVE trace (markers
+        like ``outage`` — outside the stage partition by design)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            rec = self._active.get(int(rid))
+            if rec is None:
+                return
+            rec["events"].append(dict({"name": str(name), "t": t,
+                                       "dur_ms": float(dur_ms)}, **attrs))
+
+    # ------------------------------------------------------ finish/retain
+
+    def finish(self, rid: int, outcome: str, latency_ms: float,
+               t_end: float, stages_ms: Dict[str, float],
+               **attrs: Any) -> Optional[Dict[str, Any]]:
+        """Close one trace with its terminal outcome and stage
+        partition, apply the tail-sampling policy, and return the
+        retained trace dict (``None`` when sampled out or tracing is
+        off). ``stages_ms`` must sum to ``latency_ms`` within
+        :data:`SPAN_SUM_TOL_MS` — the caller owns the partition."""
+        if not self.enabled:
+            return None
+        rid = int(rid)
+        with self._lock:
+            rec = self._active.pop(rid, None)
+        if rec is None:
+            # finish without begin (e.g. a context-free supervisor-side
+            # answer): synthesize so the outcome is still traceable
+            rec = {"trace_id": self.mint(rid),
+                   "t_submit": float(t_end) - float(latency_ms) / 1e3,
+                   "events": [], "attrs": {}}
+        trace = {
+            "trace_id": rec["trace_id"],
+            "rid": rid,
+            "outcome": str(outcome),
+            "latency_ms": float(latency_ms),
+            "t_submit": rec["t_submit"],
+            "t_end": float(t_end),
+            "stages_ms": {str(k): float(v) for k, v in stages_ms.items()},
+            "events": rec["events"],
+            "attrs": dict(rec["attrs"], **attrs),
+            "process": self.process,
+        }
+        keep, why = self._retain_decision(trace)
+        with self._lock:
+            self.finished += 1
+            if not keep:
+                self.sampled_out += 1
+                return None
+            trace["retained_because"] = why
+            self._seq += 1
+            trace["seq"] = self._seq
+            self._ring.append(trace)
+            self._by_id[trace["trace_id"]] = trace
+            while len(self._ring) > self.capacity:
+                old = self._ring.popleft()
+                self._by_id.pop(old["trace_id"], None)
+                self.evicted += 1
+            self.retained_total += 1
+        return trace
+
+    def _retain_decision(self, trace: Dict[str, Any]) -> (bool, str):
+        # tail-based: every unhealthy outcome is evidence, never sampled
+        if trace["outcome"] != "served":
+            return True, "outcome"
+        thr = None
+        if self._top_fn is not None:
+            try:
+                thr = self._top_fn()
+            except Exception:  # noqa: BLE001 - a cold/broken threshold
+                # source must not take the tracing plane down
+                thr = None
+        if thr is not None and trace["latency_ms"] >= thr:
+            return True, "top_decile"
+        if hash01(self.seed, trace["trace_id"]) < self.sample:
+            return True, "sampled"
+        return False, ""
+
+    # ----------------------------------------- post-retention annotation
+
+    def append_event(self, trace_id: str, name: str,
+                     t: Optional[float] = None, dur_ms: float = 0.0,
+                     **attrs: Any) -> bool:
+        """Append a lifecycle annotation to an already-RETAINED trace
+        (the restart-crossing path: the supervisor appends ``worker_
+        restarted`` / ``served_after_restart`` to the outage trace it
+        finished when the worker died). Returns whether the trace was
+        still in the ring."""
+        with self._lock:
+            tr = self._by_id.get(str(trace_id))
+            if tr is None:
+                return False
+            tr["events"].append(dict({"name": str(name), "t": t,
+                                      "dur_ms": float(dur_ms)}, **attrs))
+            return True
+
+    def annotate(self, trace_id: str, **attrs: Any) -> bool:
+        """Merge attrs into a retained trace (e.g. ``restart_crossed``)."""
+        with self._lock:
+            tr = self._by_id.get(str(trace_id))
+            if tr is None:
+                return False
+            tr["attrs"].update(attrs)
+            return True
+
+    # -------------------------------------------------------------- views
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """The retained ring, oldest first (structure-copied: later
+        annotation never mutates a snapshot a reader already holds)."""
+        with self._lock:
+            return [self._copy(t) for t in self._ring]
+
+    @staticmethod
+    def _copy(t: Dict[str, Any]) -> Dict[str, Any]:
+        out = dict(t)
+        out["stages_ms"] = dict(t["stages_ms"])
+        out["events"] = [dict(e) for e in t["events"]]
+        out["attrs"] = dict(t["attrs"])
+        return out
+
+    def drain_new(self) -> List[Dict[str, Any]]:
+        """Retained traces appended since the last drain (each handed
+        out exactly once — the flight-recorder feed)."""
+        with self._lock:
+            out = [self._copy(t) for t in self._ring
+                   if t["seq"] > self._drained_seq]
+            self._drained_seq = self._seq
+        return out
+
+    def exemplars(self, k: int = 5) -> List[Dict[str, Any]]:
+        """The ``p99_exemplars`` view: the ``k`` slowest retained
+        traces, each with its trace id, outcome, and per-stage
+        breakdown plus the stage that dominated it — the join between
+        the aggregate p99 attribution and actual requests."""
+        with self._lock:
+            worst = sorted(self._ring, key=lambda t: -t["latency_ms"])[:k]
+            out = []
+            for t in worst:
+                stages = t["stages_ms"]
+                out.append({
+                    "trace_id": t["trace_id"],
+                    "rid": t["rid"],
+                    "outcome": t["outcome"],
+                    "latency_ms": t["latency_ms"],
+                    "stages_ms": dict(stages),
+                    "dominant_stage": (max(stages, key=stages.get)
+                                       if stages else None),
+                })
+            return out
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"enabled": self.enabled, "capacity": self.capacity,
+                    "retained": len(self._ring),
+                    "retained_total": self.retained_total,
+                    "finished": self.finished,
+                    "sampled_out": self.sampled_out,
+                    "evicted": self.evicted, "sample": self.sample,
+                    "seed": self.seed}
+
+    # ------------------------------------------------------------- export
+
+    def to_chrome(self) -> Dict[str, Any]:
+        """The retained ring as a Chrome trace-event document (the
+        format ``utils/traceparse.py`` already parses). All request
+        events live under :data:`~.obs.REQ_EVENT_PREFIX` so mixed
+        captures keep device op events and request spans separable."""
+        return traces_to_chrome(self.snapshot())
+
+    def export(self, path: str) -> str:
+        """Write :meth:`to_chrome` as JSON (gzip when the path ends in
+        ``.gz``); returns the path."""
+        body = json.dumps(self.to_chrome())
+        if path.endswith(".gz"):
+            with gzip.open(path, "wt", encoding="utf-8") as f:
+                f.write(body)
+        else:
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(body)
+        return path
+
+
+def traces_to_chrome(traces: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Render finished trace dicts as one Chrome trace-event document:
+    an enclosing ``req/<outcome>`` X event per trace (args carry the
+    trace id, rid, outcome, and attrs), ``req/stage/<name>`` children
+    laid out sequentially from ``t_submit`` (the partition renders as
+    touching spans), ``req/mark/<name>`` lifecycle annotations, and ONE
+    ``req/flush`` span per flush id — the coalesce span linking the N
+    request traces that shared a flush."""
+    events: List[Dict[str, Any]] = []
+    flushes: Dict[Any, Dict[str, Any]] = {}
+    for t in traces:
+        base_us = t["t_submit"] * 1e6
+        tid = int(t.get("rid", 0))
+        args = dict(t.get("attrs", {}))
+        args.update(trace_id=t["trace_id"], rid=t.get("rid"),
+                    outcome=t["outcome"], latency_ms=t["latency_ms"],
+                    process=t.get("process", "?"),
+                    retained_because=t.get("retained_because"))
+        events.append({"name": REQ_EVENT_PREFIX + t["outcome"],
+                       "ph": "X", "ts": base_us,
+                       "dur": t["latency_ms"] * 1e3,
+                       "pid": 1, "tid": tid, "args": args})
+        cur = base_us
+        for stage, ms in t.get("stages_ms", {}).items():
+            events.append({"name": f"{REQ_EVENT_PREFIX}stage/{stage}",
+                           "ph": "X", "ts": cur, "dur": ms * 1e3,
+                           "pid": 1, "tid": tid,
+                           "args": {"trace_id": t["trace_id"],
+                                    "stage": stage, "ms": ms}})
+            cur += ms * 1e3
+        for ev in t.get("events", []):
+            ts = (ev.get("t") * 1e6 if ev.get("t") is not None
+                  else base_us + t["latency_ms"] * 1e3)
+            extra = {k: v for k, v in ev.items()
+                     if k not in ("name", "t", "dur_ms")}
+            events.append({"name": f"{REQ_EVENT_PREFIX}mark/{ev['name']}",
+                           "ph": "X", "ts": ts,
+                           "dur": ev.get("dur_ms", 0.0) * 1e3,
+                           "pid": 1, "tid": tid,
+                           "args": dict(extra, trace_id=t["trace_id"])})
+        fid = t.get("attrs", {}).get("flush")
+        if fid is not None and fid not in flushes:
+            t0 = t["attrs"].get("flush_t0", t["t_submit"])
+            flushes[fid] = {
+                "name": REQ_EVENT_PREFIX + "flush", "ph": "X",
+                "ts": t0 * 1e6, "dur": max(0.0, (t["t_end"] - t0) * 1e6),
+                "pid": 1, "tid": tid,
+                "args": {"flush_id": fid,
+                         "coalesced": t["attrs"].get("coalesced"),
+                         "rung": t["attrs"].get("rung")}}
+    events.extend(flushes.values())
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

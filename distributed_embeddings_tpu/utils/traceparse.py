@@ -160,7 +160,10 @@ def parse_events(doc: Dict[str, Any],
         if not isinstance(dur, (int, float)) or dur <= 0:
             continue
         name = str(e.get("name", ""))
-        if is_host_event(name):
+        if is_host_event(name) or obs.is_request_event(name):
+            # request-tracing events (utils/reqtrace.py exports into the
+            # same Chrome-trace container) are serving spans, not device
+            # work — parse_request_traces reads them
             continue
         args = e.get("args")
         phase, resolved = _phase_from_args(
@@ -357,3 +360,57 @@ def measure_events(events: Sequence[TraceEvent],
             else None),
         "overlap_min_frac": overlap_min_frac,
     }
+
+
+# ------------------------------------------------- request-trace parsing
+
+
+def parse_request_traces(path_or_doc: Any) -> List[Dict[str, Any]]:
+    """The inverse of :func:`~.reqtrace.traces_to_chrome`: regroup the
+    ``req/*`` events of a Chrome trace document (or a path to one,
+    ``.gz`` fine) back into per-request trace summaries.
+
+    Every returned dict has ``trace_id`` / ``outcome`` / ``rid`` /
+    ``latency_ms`` / ``stages_ms`` / ``events`` / ``attrs`` — enough to
+    re-check the span-partition invariant (``sum(stages_ms.values()) ==
+    latency_ms``) and to find the restart-crossing trace without ever
+    importing the writer. Coalesce (``req/flush``) spans are surfaced
+    separately under ``"flushes"`` in each trace's ``attrs`` owner; they
+    are returned as-is in no trace (they link several).
+    """
+    doc = (load_trace(path_or_doc) if isinstance(path_or_doc, str)
+           else path_or_doc)
+    traces: Dict[str, Dict[str, Any]] = {}
+    for e in doc.get("traceEvents") or []:
+        name = str(e.get("name", ""))
+        if e.get("ph") != "X" or not obs.is_request_event(name):
+            continue
+        kind = name[len(obs.REQ_EVENT_PREFIX):]
+        args = e.get("args") or {}
+        tid = args.get("trace_id")
+        if kind == "flush" or tid is None:
+            continue
+        rec = traces.setdefault(tid, {
+            "trace_id": tid, "outcome": None, "rid": None,
+            "latency_ms": None, "stages_ms": {}, "events": [],
+            "attrs": {}})
+        if kind.startswith("stage/"):
+            rec["stages_ms"][kind[len("stage/"):]] = float(
+                args.get("ms", float(e.get("dur", 0.0)) / 1e3))
+        elif kind.startswith("mark/"):
+            ev = {k: v for k, v in args.items() if k != "trace_id"}
+            ev["name"] = kind[len("mark/"):]
+            ev["dur_ms"] = float(e.get("dur", 0.0)) / 1e3
+            rec["events"].append(ev)
+        else:
+            # the envelope event: kind IS the outcome
+            rec["outcome"] = kind
+            rec["rid"] = args.get("rid")
+            rec["latency_ms"] = args.get(
+                "latency_ms", float(e.get("dur", 0.0)) / 1e3)
+            rec["attrs"] = {k: v for k, v in args.items()
+                            if k not in ("trace_id", "rid", "outcome",
+                                         "latency_ms")}
+    # envelope-less fragments (partial exports) are dropped: without an
+    # outcome there is nothing to gate on
+    return [t for t in traces.values() if t["outcome"] is not None]

@@ -1,0 +1,376 @@
+"""Cross-process request tracing (``utils/reqtrace.py`` + its serving
+and supervisor integration).
+
+The contracts under test:
+
+* every terminal outcome — ``Served``, ``Expired``, ``Overloaded``,
+  ``Failed``, ``Unavailable`` — carries a ``spans`` partition whose
+  values sum to its ``latency_ms`` within ``SPAN_SUM_TOL_MS``, and
+  books a trace with the same invariant;
+* retention is tail-based and DETERMINISTIC: unhealthy outcomes always
+  retain, the rest by a seeded hash of the trace id (no wall clock, no
+  ``random``) or the latency top decile — two buffers with the same
+  seed retain identical sets;
+* the retained ring is bounded: a 10x burst past capacity evicts
+  oldest-first and never grows the ring;
+* the Chrome export round-trips through the jax-free
+  ``utils/traceparse.parse_request_traces`` reader (gzip included) and
+  stays out of the device-event parser's way;
+* the flight recorder's black box carries the trace ring under its CRC:
+  a tampered ``traces`` entry fails ``verify_blackbox``;
+* the metrics federation primitives (``merge_registry_docs`` /
+  ``render_doc`` / ``add_federated``) merge sketches, sum counters, and
+  render one scrape document without duplicating metadata.
+"""
+
+import gzip
+import json
+import os
+import zlib
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from distributed_embeddings_tpu.parallel import (
+    Expired, Failed, Overloaded, Served, Unavailable)
+from distributed_embeddings_tpu.parallel import serving as sv
+from distributed_embeddings_tpu.utils import mplane, obs, reqtrace, traceparse
+
+from tests.test_serving import _build, _req, _tmpl
+
+
+def _buf(**kw):
+    kw.setdefault("capacity", 64)
+    kw.setdefault("sample", 1.0)
+    kw.setdefault("seed", 0)
+    kw.setdefault("enabled", True)
+    return reqtrace.TraceBuffer(**kw)
+
+
+def _finish_one(buf, rid, outcome="served", latency_ms=5.0, t0=100.0,
+                stages=None, **attrs):
+    buf.begin(rid, t0)
+    return buf.finish(rid, outcome, latency_ms, t0 + latency_ms / 1e3,
+                      stages or {"queue_wait": latency_ms}, **attrs)
+
+
+# ---------------------------------------------------- retention policy
+
+
+def test_unhealthy_outcomes_always_retained():
+    buf = _buf(sample=0.0)   # sampling would drop EVERY healthy trace
+    for i, outcome in enumerate(
+            ("expired", "failed", "overloaded", "unavailable")):
+        tr = _finish_one(buf, i, outcome=outcome)
+        assert tr is not None and tr["retained_because"] == "outcome"
+    assert _finish_one(buf, 99, outcome="served") is None
+    st = buf.stats()
+    assert st["retained"] == 4 and st["sampled_out"] == 1
+
+
+def test_top_decile_retention_overrides_sampling():
+    thresh = {"v": None}
+    buf = _buf(sample=0.0, top_fn=lambda: thresh["v"])
+    assert _finish_one(buf, 0, latency_ms=50.0) is None  # cold: sampled
+    thresh["v"] = 10.0
+    tr = _finish_one(buf, 1, latency_ms=50.0)
+    assert tr is not None and tr["retained_because"] == "top_decile"
+    assert _finish_one(buf, 2, latency_ms=5.0) is None   # under threshold
+
+
+def test_sampling_is_seeded_and_deterministic():
+    def retained_ids(seed):
+        buf = _buf(capacity=1024, sample=0.35, seed=seed)
+        for i in range(200):
+            _finish_one(buf, i)
+        return [t["trace_id"] for t in buf.snapshot()]
+
+    a, b = retained_ids(7), retained_ids(7)
+    assert a == b and 0 < len(a) < 200
+    assert retained_ids(8) != a
+    # the decision is a pure function of (seed, trace_id) — crc32, no
+    # wall clock, no random module
+    tid = reqtrace.TraceBuffer(seed=7).mint(3)
+    expect = (zlib.crc32(f"7:{tid}".encode()) & 0xFFFFFFFF) / 2.0 ** 32
+    assert reqtrace.hash01(7, tid) == expect
+
+
+def test_ring_bounded_under_10x_burst():
+    buf = _buf(capacity=16)
+    for i in range(160):
+        _finish_one(buf, i)
+    snap = buf.snapshot()
+    assert len(snap) == 16
+    st = buf.stats()
+    assert st["retained"] == 16 and st["evicted"] == 144
+    # oldest evicted, newest kept
+    assert [t["rid"] for t in snap] == list(range(144, 160))
+
+
+# ------------------------------------------- post-hoc marks and drains
+
+
+def test_append_event_annotate_and_exactly_once_drain():
+    buf = _buf()
+    tr = _finish_one(buf, 0, outcome="unavailable")
+    assert buf.append_event(tr["trace_id"], "worker_restarted", t=101.0)
+    assert buf.annotate(tr["trace_id"], restart_crossed=True)
+    assert not buf.append_event("t-missing", "x")
+    assert not buf.annotate("t-missing", x=1)
+    got = buf.drain_new()
+    assert [t["trace_id"] for t in got] == [tr["trace_id"]]
+    assert got[0]["attrs"]["restart_crossed"]
+    assert buf.drain_new() == []          # cursor advanced
+    _finish_one(buf, 1, outcome="failed")
+    assert len(buf.drain_new()) == 1      # only the new one
+
+
+def test_disabled_buffer_noops():
+    buf = _buf(enabled=False)
+    assert buf.begin(0, 1.0) is None
+    assert buf.finish(0, "failed", 1.0, 1.0, {"queue_wait": 1.0}) is None
+    assert buf.snapshot() == [] and not buf.stats()["enabled"]
+
+
+# -------------------------------------------- Chrome export round trip
+
+
+def test_chrome_export_roundtrip_and_namespace(tmp_path):
+    buf = _buf()
+    _finish_one(buf, 0, latency_ms=4.0,
+                stages={"queue_wait": 1.0, "coalesce": 0.5,
+                        "dispatch": 0.5, "device_compute": 1.5,
+                        "reply_slice": 0.5},
+                flush=3, coalesced=2, flush_t0=100.0005)
+    tr = _finish_one(buf, 1, outcome="unavailable", latency_ms=20.0)
+    buf.append_event(tr["trace_id"], "worker_restarted", t=101.0)
+    buf.annotate(tr["trace_id"], restart_crossed=True)
+
+    for name in ("req.trace.json", "req.trace.json.gz"):
+        path = os.path.join(tmp_path, name)
+        buf.export(path)
+        opener = gzip.open if name.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            doc = json.loads(f.read().decode())
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert obs.REQ_EVENT_PREFIX + "served" in names
+        assert obs.REQ_EVENT_PREFIX + "stage/device_compute" in names
+        assert obs.REQ_EVENT_PREFIX + "mark/worker_restarted" in names
+        assert obs.REQ_EVENT_PREFIX + "flush" in names
+
+        parsed = {t["trace_id"]: t
+                  for t in traceparse.parse_request_traces(path)}
+        assert len(parsed) == 2
+        served = next(t for t in parsed.values()
+                      if t["outcome"] == "served")
+        assert abs(sum(served["stages_ms"].values())
+                   - served["latency_ms"]) <= reqtrace.SPAN_SUM_TOL_MS
+        crossed = parsed[tr["trace_id"]]
+        assert crossed["attrs"]["restart_crossed"]
+        assert any(e["name"] == "worker_restarted"
+                   for e in crossed["events"])
+        # the request namespace stays OUT of the device-event parser
+        assert traceparse.parse_events(doc) == []
+
+
+# ----------------------------------- every terminal outcome has spans
+
+
+def _spans_sum_ok(res):
+    assert res.spans, f"{type(res).__name__} carries no spans"
+    assert abs(sum(res.spans.values()) - res.latency_ms) \
+        <= reqtrace.SPAN_SUM_TOL_MS
+
+
+def _retain_all(rt):
+    rt.traces = reqtrace.TraceBuffer(
+        capacity=64, sample=1.0, seed=0, enabled=True, process="serve",
+        top_fn=rt._trace_top_decile)
+
+
+def test_served_spans_partition_and_trace(monkeypatch):
+    de, state, rt, clock = _build()
+    _retain_all(rt)
+    rt.warmup(_tmpl())
+    rng = np.random.default_rng(0)
+    rt.submit(_req(rng, n=3))
+    res = rt.flush()
+    assert len(res) == 1 and isinstance(res[0], Served)
+    _spans_sum_ok(res[0])
+    assert set(res[0].spans) == {f"{s}_ms" for s in sv.STAGES}
+    (tr,) = rt.traces.snapshot()
+    assert tr["outcome"] == "served"
+    assert set(tr["stages_ms"]) == set(sv.STAGES)
+    assert abs(sum(tr["stages_ms"].values()) - tr["latency_ms"]) \
+        <= reqtrace.SPAN_SUM_TOL_MS
+    assert tr["attrs"]["coalesced"] == 1
+
+
+def test_expired_overloaded_failed_spans():
+    de, state, rt, clock = _build(max_batch=8, max_queue=8)
+    _retain_all(rt)
+    rt.warmup(_tmpl())
+    rng = np.random.default_rng(1)
+
+    # Expired: the deadline passes before any flush
+    tight = _req(rng, n=2)
+    tight.deadline_ms = 5.0
+    rt.submit(tight)
+    clock.t += 1.0
+    expired = [r for r in rt.poll() if isinstance(r, Expired)]
+    assert len(expired) == 1
+    _spans_sum_ok(expired[0])
+    assert expired[0].spans == {"queue_wait_ms": expired[0].latency_ms}
+
+    # Overloaded: flood past max_queue
+    shed = []
+    for _ in range(24):
+        r = rt.submit(_req(rng, n=2))
+        if isinstance(r, Overloaded):
+            shed.append(r)
+    assert shed
+    _spans_sum_ok(shed[0])
+    rt.flush()
+
+    # Failed: the flush itself raises -> typed Failed, spans intact
+    def boom(reqs, rung):
+        raise RuntimeError("boom")
+    rt._run_flush = boom
+    rt.submit(_req(rng, n=2))
+    clock.t += 1.0
+    failed = [r for r in rt.flush() if isinstance(r, Failed)]
+    assert len(failed) == 1 and "boom" in failed[0].reason
+    _spans_sum_ok(failed[0])
+
+    by_outcome = {t["outcome"] for t in rt.traces.snapshot()}
+    assert {"expired", "overloaded", "failed"} <= by_outcome
+    for t in rt.traces.snapshot():
+        assert abs(sum(t["stages_ms"].values()) - t["latency_ms"]) \
+            <= reqtrace.SPAN_SUM_TOL_MS
+
+
+def test_unavailable_spans_from_unstarted_supervisor():
+    from distributed_embeddings_tpu.parallel import Supervisor
+
+    sup = Supervisor("tools.isolation_common:worker_factory")
+    try:
+        res = sup.submit(sv.Request(cats=[np.zeros(1, np.int32)]))
+        assert isinstance(res, Unavailable)
+        _spans_sum_ok(res)
+        (tr,) = sup.traces.snapshot()
+        assert tr["outcome"] == "unavailable"
+        assert tr["retained_because"] == "outcome"
+        assert sup._outage_trace == tr["trace_id"]
+    finally:
+        sup._listener.close()
+
+
+def test_stats_unhealthy_view_and_exemplars():
+    de, state, rt, clock = _build()
+    _retain_all(rt)
+    rt.warmup(_tmpl())
+    rng = np.random.default_rng(2)
+    tight = _req(rng, n=2)
+    tight.deadline_ms = 5.0
+    rt.submit(tight)
+    clock.t += 1.0
+    rt.poll()
+    rt.submit(_req(rng, n=2))
+    rt.flush()
+    st = rt.stats()
+    # the plain per-stage view keeps EXACTLY the five healthy children
+    # (check_obsplane's stage-ratio gate sums them against served p99)
+    assert set(st["latency_stages_ms"]) == set(sv.STAGES)
+    assert "expired" in st["latency_stages_unhealthy_ms"]
+    assert st["latency_stages_unhealthy_ms"]["expired"]["count"] == 1
+    assert st["trace"]["retained"] == len(rt.traces.snapshot())
+    exemplars = st["p99_exemplars"]
+    assert exemplars and all(
+        {"trace_id", "outcome", "latency_ms", "dominant_stage"}
+        <= set(e) for e in exemplars)
+    # exemplars rank by latency, slowest first
+    lats = [e["latency_ms"] for e in exemplars]
+    assert lats == sorted(lats, reverse=True)
+
+
+# --------------------------------------------- flight-recorder blackbox
+
+
+def test_blackbox_carries_traces_under_crc(tmp_path):
+    path = os.path.join(tmp_path, "bb.blackbox.json")
+    rec = mplane.FlightRecorder(path)
+    buf = _buf()
+    _finish_one(buf, 0, outcome="failed", latency_ms=7.0)
+    for tr in buf.drain_new():
+        rec.note_trace(tr)
+    rec.dump("test", reason="trace_ring")
+    payload = mplane.verify_blackbox(path)
+    assert payload["traces"] and \
+        payload["traces"][0]["outcome"] == "failed"
+
+    # tampering with a trace breaks the CRC: the ring is COVERED, not
+    # appended outside the envelope
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    doc["payload"]["traces"][0]["latency_ms"] = 1e9
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    with pytest.raises(ValueError):
+        mplane.verify_blackbox(path)
+
+
+# ------------------------------------------------- metrics federation
+
+
+def _doc_with(counter=None, sketch_vals=(), gauge=None):
+    reg = mplane.MetricsRegistry()
+    if counter is not None:
+        reg.counter("detpu_test_total", "t").inc(counter)
+    if sketch_vals:
+        fam = reg.sketch("detpu_test_ms", "t")
+        for v in sketch_vals:
+            fam.observe(v)
+    if gauge is not None:
+        reg.gauge("detpu_test_g", "t").set(gauge)
+    return reg.to_dict()
+
+
+def test_merge_registry_docs_sums_and_merges():
+    a = _doc_with(counter=2.0, sketch_vals=(1.0, 2.0), gauge=5.0)
+    b = _doc_with(counter=3.0, sketch_vals=(3.0,), gauge=9.0)
+    a_json = json.dumps(a, sort_keys=True)
+    merged = mplane.merge_registry_docs([a, b])
+    assert json.dumps(a, sort_keys=True) == a_json   # inputs untouched
+
+    (cnt,) = merged["detpu_test_total"]["series"]
+    assert cnt["value"] == 5.0
+    (summ,) = merged["detpu_test_ms"]["series"]
+    sk = mplane.QuantileSketch.from_dict(summ["value"])
+    assert sk.count == 3
+    (g,) = merged["detpu_test_g"]["series"]
+    assert g["value"] == 9.0                          # gauge: last wins
+
+
+def test_render_doc_skips_duplicate_metadata():
+    doc = _doc_with(counter=1.0, sketch_vals=(2.0,))
+    text = mplane.render_doc(doc)
+    assert "# HELP detpu_test_total" in text
+    assert "detpu_test_ms_count 1" in text
+    skipped = mplane.render_doc(doc, skip_meta_for={"detpu_test_total"})
+    assert "# HELP detpu_test_total" not in skipped
+    assert "detpu_test_total 1" in skipped
+
+
+def test_add_federated_serves_one_merged_view():
+    sup = mplane.MetricsRegistry()
+    sup.counter("detpu_supervisor_total", "s").inc()
+    worker_doc = _doc_with(counter=4.0, sketch_vals=(1.0, 5.0))
+    sup.add_federated(lambda: worker_doc)
+    text = sup.render()
+    assert "detpu_supervisor_total 1" in text
+    assert "detpu_test_total 4" in text
+    assert "detpu_test_ms_count 2" in text
+    # a failing source degrades to the registry's own families
+    sup.add_federated(lambda: (_ for _ in ()).throw(RuntimeError("x")))
+    assert "detpu_supervisor_total 1" in sup.render()

@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+"""Verify gate for cross-process request tracing (run by ``make
+check-tracing`` inside ``make verify``) — the outage-spanning trace
+drill.
+
+CPU end-to-end, one child on the 8-virtual-device mesh that spawns a
+REAL world-8 serving worker through the supervisor and drives a
+wall-clock request stream with a 4x burst into a ``DETPU_FAULT=die@150``
+crash. The gate asserts the tracing plane's four contracts:
+
+1. **one trace crosses the restart**: a retained supervisor-side trace
+   carries the outage — submit, ``outage`` mark, typed ``Unavailable``
+   — AND the ``worker_restarted`` / ``served_after_restart`` marks the
+   reborn worker's first Served appends (``restart_crossed`` attr);
+2. **the span partition is exact**: every retained trace's stage spans
+   sum to its ``latency_ms`` within ``SPAN_SUM_TOL_MS`` (1e-6 ms) —
+   including the five-stage partitions the worker pickled back over the
+   supervisor socket;
+3. **the federated scrape is one view**: the supervisor's ``/metrics``
+   endpoint (scraped over HTTP mid-drill, after the restart) serves the
+   WORKER's families (``detpu_serve_*`` — arrived on pong heartbeats,
+   sketch-merged across the dead and reborn incarnations) next to its
+   own (``detpu_supervisor_*``);
+4. **tracing is free at steady state**: the reborn worker reports 0
+   steady-state recompiles, and the Chrome export round-trips through
+   the jax-free ``utils/traceparse.py`` reader.
+
+Exit 0 when the drill passes; 1 with a readable reason otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WORLD = 8
+QPS = 120.0       # normal arrival rate against the worker
+BURST_AT = 1      # second of the stream the 4x spike hits
+BURST_X = 4.0
+DIE_AT = 150      # global request ordinal that os._exit()s the worker
+
+_CHILD = """
+import sys, time, urllib.request
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update('jax_platforms', 'cpu')
+from distributed_embeddings_tpu.parallel import (
+    RealtimeDriver, Served, Supervisor, SuperviseConfig, Unavailable)
+from distributed_embeddings_tpu.utils import mplane, reqtrace, traceparse
+from tools import isolation_common as ic
+
+world = {world}
+sup = Supervisor(
+    "tools.isolation_common:worker_factory", {{"world": world}},
+    config=SuperviseConfig(
+        env={{"DETPU_FAULT": "die@{die_at}", "DETPU_METRICS_PORT": ""}}))
+sup.start()
+built = ic.build(world=world)
+sup.install_snapshot(built["state"], built["streaming"][1],
+                     version=1, train_step=0)
+exp = mplane.start_http_exporter(sup.metrics, port=0)
+
+driver = RealtimeDriver(sup, ic.make_request_fn(seed=3), {qps},
+                        duration_s=None, burst_positions={{{burst_at}}},
+                        burst_x={burst_x}, drain_s=60.0)
+driver.start()
+deadline = time.monotonic() + 180
+while time.monotonic() < deadline:
+    blk = sup.stats(sync=False)["supervisor"]
+    if blk["worker_alive"] and blk["restarts"] >= 1:
+        break
+    time.sleep(0.2)
+driver.stop()
+driver.join(timeout=120)
+
+# post-restart tail: the reborn worker serves, its first Served stamps
+# the restart-crossing marks onto the outage trace
+tail = RealtimeDriver(sup, ic.make_request_fn(seed=4), 60.0,
+                      duration_s=1.0, burst_positions=(), drain_s=60.0)
+tail.start()
+tail.join(timeout=120)
+
+# give the reborn worker's federation document a pong cycle to arrive,
+# then scrape the merged /metrics view over HTTP mid-load
+time.sleep(1.2)
+scrape = urllib.request.urlopen(exp.url(), timeout=30).read().decode()
+
+st = sup.stats(sync=True)
+snap = sup.traces.snapshot()
+export_path = {export!r}
+sup.traces.export(export_path)
+exp.stop()
+sup.close()
+
+results = driver.results() + tail.results()
+served = sum(1 for r in results if isinstance(r, Served))
+unavailable = sum(1 for r in results if isinstance(r, Unavailable))
+
+crossing = [t for t in snap if t["attrs"].get("restart_crossed")]
+cross_marks = 0
+for t in crossing:
+    names = {{e["name"] for e in t["events"]}}
+    if {{"worker_restarted", "served_after_restart"}} <= names:
+        cross_marks += 1
+span_bad = sum(
+    1 for t in snap
+    if abs(sum(t["stages_ms"].values()) - t["latency_ms"])
+    > reqtrace.SPAN_SUM_TOL_MS)
+served_full = sum(1 for t in snap if t["outcome"] == "served"
+                  and len(t["stages_ms"]) == 5)
+
+parsed = traceparse.parse_request_traces(export_path)
+parse_ok = int(len(parsed) == len(snap) and any(
+    p["attrs"].get("restart_crossed") for p in parsed))
+fed_ok = int("detpu_serve_latency_ms" in scrape
+             and "detpu_serve_total" in scrape
+             and "detpu_supervisor_restarts" in scrape
+             and "detpu_supervisor_worker_alive 1" in scrape)
+blk = st["supervisor"]
+exemplars = blk["p99_exemplars"]
+
+print("FINAL",
+      "SERVED", served, "UNAVAILABLE", unavailable,
+      "CRASHES", blk["crashes"], "RESTARTS", blk["restarts"],
+      "RETAINED", len(snap),
+      "RING_OK", int(len(snap) <= sup.traces.stats()["capacity"]),
+      "CROSS", len(crossing), "CROSS_MARKS", cross_marks,
+      "SPAN_BAD", span_bad, "SERVED_FULL", served_full,
+      "PARSE_OK", parse_ok, "FED_OK", fed_ok,
+      "EXEMPLARS", len(exemplars),
+      "STEADY", st.get("steady_state_recompiles", -1),
+      flush=True)
+"""
+
+
+def main() -> int:
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="detpu_tracing_") as td:
+        export = os.path.join(td, "req.trace.json.gz")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        for k in ("DETPU_FAULT", "DETPU_OBS", "DETPU_TELEMETRY",
+                  "DETPU_METRICS_PORT", "DETPU_TRACE",
+                  "DETPU_TRACE_RING", "DETPU_TRACE_SAMPLE",
+                  "DETPU_TRACE_SEED"):
+            env.pop(k, None)
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={WORLD}")
+        code = _CHILD.format(repo=REPO, world=WORLD, qps=QPS,
+                             burst_at=BURST_AT, burst_x=BURST_X,
+                             die_at=DIE_AT, export=export)
+        p = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True, timeout=900)
+        if p.returncode != 0:
+            return _fail([f"drill child failed rc={p.returncode}: "
+                          f"{(p.stderr or p.stdout).strip()[-1500:]}"])
+        got = None
+        for line in reversed(p.stdout.strip().splitlines()):
+            if line.startswith("FINAL"):
+                parts = line.split()
+                got = dict(zip(parts[1::2], parts[2::2]))
+                break
+        if got is None:
+            return _fail(["drill child printed no FINAL line: "
+                          f"{p.stdout.strip()[-800:]}"])
+        errors = []
+        if int(got.get("CRASHES", 0)) < 1 or int(got.get("RESTARTS", 0)) < 1:
+            errors.append(
+                f"no crash/restart (crashes={got.get('CRASHES')}, "
+                f"restarts={got.get('RESTARTS')}) — die@{DIE_AT} never "
+                "fired; the drill tested nothing")
+        if int(got.get("UNAVAILABLE", 0)) < 1:
+            errors.append("no Unavailable responses — the outage window "
+                          "was empty, nothing for a trace to cross")
+        if int(got.get("RETAINED", 0)) < 1 or got.get("RING_OK") != "1":
+            errors.append(
+                f"trace ring bad (retained={got.get('RETAINED')}, "
+                f"ring_ok={got.get('RING_OK')}) — retention is either "
+                "empty or unbounded")
+        if int(got.get("CROSS", 0)) < 1 or int(got.get("CROSS_MARKS", 0)) < 1:
+            errors.append(
+                f"no restart-crossing trace (crossed={got.get('CROSS')}, "
+                f"with_marks={got.get('CROSS_MARKS')}) — the outage trace "
+                "must carry worker_restarted + served_after_restart marks "
+                "from the reborn worker's first Served")
+        if got.get("SPAN_BAD") != "0":
+            errors.append(
+                f"{got.get('SPAN_BAD')} trace(s) break the span "
+                "partition: sum(stages_ms) != latency_ms within "
+                f"{_tol()} ms")
+        if int(got.get("SERVED_FULL", 0)) < 1:
+            errors.append(
+                "no retained served trace carries the full five-stage "
+                "partition — the worker's spans did not survive the "
+                "supervisor boundary")
+        if got.get("PARSE_OK") != "1":
+            errors.append(
+                "Chrome export did not round-trip through "
+                "utils/traceparse.parse_request_traces (count mismatch "
+                "or the restart-crossing trace vanished)")
+        if got.get("FED_OK") != "1":
+            errors.append(
+                "federated scrape incomplete — /metrics must serve the "
+                "worker's detpu_serve_* families (pong-federated) next "
+                "to the supervisor's own")
+        if int(got.get("EXEMPLARS", 0)) < 1:
+            errors.append("stats() returned no p99 exemplars despite a "
+                          "retained tail")
+        if got.get("STEADY") != "0":
+            errors.append(
+                f"{got.get('STEADY')} steady-state recompile(s) — "
+                "tracing must not perturb the serve ladder's compile "
+                "cache")
+        if errors:
+            return _fail(errors)
+        print(f"check_tracing: OK (die@{DIE_AT} mid-burst: "
+              f"{got['CROSS']} trace(s) crossed the restart with marks, "
+              f"{got['RETAINED']} retained / ring bounded, 0 span-sum "
+              f"violations ({got['SERVED_FULL']} five-stage served "
+              f"partitions over the boundary), federated scrape serves "
+              f"worker + supervisor families, {got['EXEMPLARS']} p99 "
+              f"exemplars, export round-trips, {got['STEADY']} "
+              "steady-state recompiles)")
+        return 0
+
+
+def _tol() -> float:
+    from distributed_embeddings_tpu.utils import reqtrace
+    return reqtrace.SPAN_SUM_TOL_MS
+
+
+def _fail(errors) -> int:
+    for e in errors:
+        print(f"check_tracing: {e}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

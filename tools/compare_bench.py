@@ -807,6 +807,87 @@ def check_obs_plane(old: Dict[str, Any], new: Dict[str, Any]) -> int:
     return failures
 
 
+#: tracing-on throughput must stay within this fraction of tracing-off
+#: WITHIN the same record (retain-everything is the tracer's worst case)
+TRACING_ON_MIN_FRAC = 0.7
+
+#: tracing-off throughput may not drop below this fraction of the
+#: baseline's (the "tracing off costs nothing" ratchet; loose enough
+#: for shared-CPU noise, tight enough to catch a hot-path tax)
+TRACING_OFF_MIN_FRAC = 0.6
+
+
+def check_tracing(old: Dict[str, Any], new: Dict[str, Any]) -> int:
+    """Gate the ``tracing`` section (ISSUE 20): request tracing must be
+    free when off, bounded when on, and exact always.
+
+    * ``span_sum_ok`` != 1 fails — a retained trace whose stage spans
+      do not sum to its ``latency_ms`` is a lying instrument;
+    * ``trace_off_disabled`` != 1 fails — the off run actually traced;
+    * nonzero ``steady_state_recompiles`` fails — tracing perturbed the
+      serve ladder's compile cache;
+    * ``retained`` must be positive and bounded by ``ring_capacity``;
+    * ``tracing_on_rps`` below :data:`TRACING_ON_MIN_FRAC` x
+      ``tracing_off_rps`` (same record) fails — the tracer's
+      retain-everything worst case grew into a workload;
+    * ``tracing_off_rps`` below :data:`TRACING_OFF_MIN_FRAC` x the
+      baseline's fails — the disabled path grew a tax;
+    * a candidate missing the section while the baseline has it fails.
+    """
+    sec = new.get("tracing")
+    if not isinstance(sec, dict):
+        if isinstance(old.get("tracing"), dict):
+            print("compare_bench: candidate has no 'tracing' section "
+                  "but the baseline does — the tracing cost measurement "
+                  "failed or was dropped", file=sys.stderr)
+            return 1
+        return 0
+    failures = 0
+    if sec.get("span_sum_ok") != 1:
+        print("compare_bench: tracing span_sum_ok != 1 — a retained "
+              "trace's stage spans do not sum to its latency_ms within "
+              "tolerance", file=sys.stderr)
+        failures += 1
+    if sec.get("trace_off_disabled") != 1:
+        print("compare_bench: tracing trace_off_disabled != 1 — the "
+              "tracing-off baseline run was actually tracing",
+              file=sys.stderr)
+        failures += 1
+    rc = sec.get("steady_state_recompiles")
+    if isinstance(rc, (int, float)) and rc > 0:
+        print(f"compare_bench: tracing section recompiled {int(rc)} "
+              "time(s) at steady state — tracing perturbed the serve "
+              "ladder", file=sys.stderr)
+        failures += 1
+    retained, cap = sec.get("retained"), sec.get("ring_capacity")
+    if not isinstance(retained, (int, float)) or retained < 1 \
+            or (isinstance(cap, (int, float)) and retained > cap):
+        print(f"compare_bench: tracing retained={retained!r} of "
+              f"capacity={cap!r} — retention is empty or unbounded",
+              file=sys.stderr)
+        failures += 1
+    off, on = sec.get("tracing_off_rps"), sec.get("tracing_on_rps")
+    if isinstance(off, (int, float)) and isinstance(on, (int, float)) \
+            and off > 0 and on < off * TRACING_ON_MIN_FRAC:
+        print(f"compare_bench: tracing-on throughput {on:.0f} rps < "
+              f"{TRACING_ON_MIN_FRAC:.0%} of tracing-off {off:.0f} rps "
+              "— the retain-everything worst case costs too much",
+              file=sys.stderr)
+        failures += 1
+    osec = old.get("tracing")
+    if isinstance(osec, dict):
+        o_off = osec.get("tracing_off_rps")
+        if isinstance(o_off, (int, float)) and o_off > 0 \
+                and isinstance(off, (int, float)) \
+                and off < o_off * TRACING_OFF_MIN_FRAC:
+            print(f"compare_bench: tracing-off throughput REGRESSION: "
+                  f"{o_off:.0f} -> {off:.0f} rps (below the "
+                  f"{TRACING_OFF_MIN_FRAC:.0%} ratchet) — the disabled "
+                  "tracer grew a hot-path tax", file=sys.stderr)
+            failures += 1
+    return failures
+
+
 def compare(old: Dict[str, Any], new: Dict[str, Any],
             threshold: float) -> int:
     steady_failures = check_steady_state(new)
@@ -822,6 +903,7 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
     steady_failures += check_online(old, new)
     steady_failures += check_isolated_serving(old, new)
     steady_failures += check_obs_plane(old, new)
+    steady_failures += check_tracing(old, new)
     regressions = 0
     rows = []
     for keys, higher_better in ((THROUGHPUT_KEYS, True), (MS_KEYS, False)):

@@ -141,12 +141,53 @@ def fuse_report(metrics: Optional[Dict[str, Any]],
                 telemetry: Optional[Dict[str, Any]],
                 hbm: Optional[Dict[str, Any]],
                 verified: Optional[Dict[str, Any]] = None,
-                phases: Optional[List[Dict[str, Any]]] = None
+                phases: Optional[List[Dict[str, Any]]] = None,
+                traces: Optional[Dict[str, Any]] = None
                 ) -> Dict[str, Any]:
     """One observatory record from whichever inputs exist."""
     return {"metric": "obs_report", "metrics": metrics,
             "telemetry": telemetry, "hbm": hbm, "verified": verified,
-            "phases": phases}
+            "phases": phases, "traces": traces}
+
+
+def load_request_traces(path: str) -> Dict[str, Any]:
+    """A request-trace export (``TraceBuffer.export``'s Chrome trace
+    document, ``.gz`` fine) -> the report's trace digest: outcome
+    histogram, span-partition violations (``sum(stages) != latency``
+    beyond the writer's tolerance), restart-crossing traces, and the
+    slowest retained requests with their dominant stage."""
+    from distributed_embeddings_tpu.utils import reqtrace, traceparse
+
+    recs = traceparse.parse_request_traces(path)
+    outcomes: Dict[str, int] = {}
+    bad_sum = 0
+    crossing: List[str] = []
+    for t in recs:
+        outcomes[t["outcome"]] = outcomes.get(t["outcome"], 0) + 1
+        lat = t.get("latency_ms")
+        if isinstance(lat, (int, float)) and t["stages_ms"] and \
+                abs(sum(t["stages_ms"].values()) - lat) \
+                > reqtrace.SPAN_SUM_TOL_MS:
+            bad_sum += 1
+        if t["attrs"].get("restart_crossed"):
+            crossing.append(t["trace_id"])
+    slow = sorted(
+        (t for t in recs if isinstance(t.get("latency_ms"), (int, float))),
+        key=lambda t: -t["latency_ms"])[:5]
+    return {
+        "traces": len(recs),
+        "outcomes": dict(sorted(outcomes.items())),
+        "span_sum_violations": bad_sum,
+        "restart_crossing": crossing,
+        "slowest": [{
+            "trace_id": t["trace_id"], "outcome": t["outcome"],
+            "latency_ms": round(t["latency_ms"], 3),
+            "dominant_stage": (max(t["stages_ms"],
+                                   key=t["stages_ms"].get)
+                               if t["stages_ms"] else None),
+            "marks": [e["name"] for e in t["events"]],
+        } for t in slow],
+    }
 
 
 def load_phases(path: str) -> List[Dict[str, Any]]:
@@ -304,6 +345,23 @@ def render(report: Dict[str, Any]) -> str:
                             f"{calib.get('drift_max')}x"))
             for v in case.get("agreement_violations") or []:
                 lines.append(f"      VIOLATION: {v}")
+    tr = report.get("traces")
+    if tr:
+        lines.append(f"-- request traces ({tr['traces']} retained)")
+        lines.append("   outcomes: " + (", ".join(
+            f"{k} {v}" for k, v in tr["outcomes"].items()) or "none"))
+        lines.append(
+            f"   span-partition violations: {tr['span_sum_violations']}"
+            + ("  !!" if tr["span_sum_violations"] else ""))
+        if tr["restart_crossing"]:
+            lines.append("   restart-crossing: "
+                         + ", ".join(tr["restart_crossing"]))
+        for s in tr["slowest"]:
+            marks = f"  [{', '.join(s['marks'])}]" if s["marks"] else ""
+            lines.append(
+                f"   {s['trace_id']}: {s['outcome']} "
+                f"{s['latency_ms']:.3f} ms, dominant stage "
+                f"{s['dominant_stage'] or 'n/a'}{marks}")
     ver = report.get("verified")
     if ver:
         lines.append("-- verification")
@@ -487,6 +545,30 @@ def _synth_metrics(path: str, steps: int = 6, world: int = 8) -> None:
 MINI_TRACE = os.path.join(REPO, "tests", "data", "mini.trace.json.gz")
 
 
+def _synth_request_trace(tmp: str) -> str:
+    """A two-trace request export through the REAL writer (one served
+    with the full stage partition, one unavailable crossing a restart)
+    — exercises the export -> parse -> digest path end to end."""
+    from distributed_embeddings_tpu.utils import reqtrace
+
+    buf = reqtrace.TraceBuffer(capacity=16, sample=1.0, seed=7,
+                               enabled=True, process="selftest")
+    buf.begin(0, 100.0)
+    buf.finish(0, "served", 5.0, 100.005,
+               {"queue_wait": 1.0, "coalesce": 0.5, "dispatch": 0.5,
+                "device_compute": 2.5, "reply_slice": 0.5},
+               flush=1, coalesced=2, flush_t0=100.001)
+    buf.begin(1, 100.1)
+    buf.event(1, "outage", t=100.2, reason="worker_crash")
+    tr = buf.finish(1, "unavailable", 100.0, 100.2,
+                    {"queue_wait": 100.0}, stranded=True)
+    buf.append_event(tr["trace_id"], "worker_restarted", t=100.9)
+    buf.annotate(tr["trace_id"], restart_crossed=True)
+    path = os.path.join(tmp, "req.trace.json.gz")
+    buf.export(path)
+    return path
+
+
 def _selftest_phases() -> List[str]:
     """Parse the checked-in miniature trace through the jax-free parser
     and check the hand-computable numbers; returns failure strings."""
@@ -559,13 +641,17 @@ def selftest() -> int:
                                    "est_flops_per_step": 4096}],
         }
         phases = load_phases(MINI_TRACE)
+        req_traces = load_request_traces(_synth_request_trace(tmp))
         report = fuse_report(m, telemetry, hbm,
-                             {"selftest": True}, phases=phases)
+                             {"selftest": True}, phases=phases,
+                             traces=req_traces)
         text = render(report)
         required = ("access telemetry", "step metrics", "HBM budget",
                     "imbalance ratio", "a2a bytes", "zipf", "slab w8",
                     "compiled step", "measured phase profile",
-                    "id_all_to_all: serialized")
+                    "id_all_to_all: serialized",
+                    "request traces (2 retained)", "restart-crossing",
+                    "span-partition violations: 0")
         missing = [r for r in required if r not in text]
         json.dumps(report)  # must round-trip
         if m is None or m["records"] != 6:
@@ -597,6 +683,10 @@ def main(argv=None) -> int:
                          "tools/phase_profile.py --json dump, or a raw "
                          "DETPU_PROFILE_DIR trace capture (dir or "
                          ".trace.json[.gz] file, parsed jax-free)")
+    ap.add_argument("--traces", metavar="PATH",
+                    help="fuse a request-trace export (the Chrome trace "
+                         "document utils/reqtrace.py TraceBuffer.export "
+                         "writes, .gz fine)")
     ap.add_argument("--run", action="store_true",
                     help="force the live demo run even with --metrics")
     ap.add_argument("--world", type=int, default=DEMO_WORLD)
@@ -612,9 +702,9 @@ def main(argv=None) -> int:
     if args.selftest:
         return selftest()
 
-    if args.metrics or args.telemetry or args.phases:
+    if args.metrics or args.telemetry or args.phases or args.traces:
         if not args.run:
-            metrics = telemetry = phases = None
+            metrics = telemetry = phases = req_traces = None
             if args.metrics:
                 if not os.path.exists(args.metrics) and \
                         not os.path.exists(args.metrics + ".1"):
@@ -638,7 +728,16 @@ def main(argv=None) -> int:
                     print(f"obs_report: cannot read {args.phases}: {e}",
                           file=sys.stderr)
                     return 2
-            report = fuse_report(metrics, telemetry, None, phases=phases)
+            if args.traces:
+                try:
+                    req_traces = load_request_traces(args.traces)
+                except (OSError, ValueError,
+                        json.JSONDecodeError) as e:
+                    print(f"obs_report: cannot read {args.traces}: {e}",
+                          file=sys.stderr)
+                    return 2
+            report = fuse_report(metrics, telemetry, None, phases=phases,
+                                 traces=req_traces)
             print(render(report))
             _maybe_json(report, args.json)
             return 0
